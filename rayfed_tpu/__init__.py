@@ -1,0 +1,44 @@
+"""rayfed_tpu — a TPU-native cross-silo federated execution engine.
+
+A brand-new JAX/XLA-first framework with the capabilities of RayFed
+(reference: fengsp/rayfed): multi-controller execution where every party
+runs the same driver program, party-pinned ``@remote`` tasks/actors, and a
+push-based transport where the data owner initiates cross-party transfers.
+
+Unlike the reference (a thin shim over Ray + gRPC + cloudpickle), this
+framework is designed for TPUs from the start:
+
+- per-party compute dispatches to (optionally pjit-compiled) JAX callables
+  on the party's local device mesh instead of Ray GPU workers;
+- cross-party payloads travel as raw array bytes (zero-copy tensor wire
+  format) over an asyncio DCN socket transport, not pickle-of-host-copy;
+- intra-party scaling is first-class: DP/FSDP/TP/SP/EP/PP sharding
+  strategies, ring attention and Ulysses sequence parallelism live in
+  :mod:`rayfed_tpu.parallel`;
+- model families (logistic regression, ResNet, BERT, Llama + LoRA) and
+  federated algorithms (FedAvg, split/vertical FL) are included.
+
+Public API surface mirrors the reference (``fed/__init__.py:15-29``):
+``init``, ``shutdown``, ``remote``, ``get``, ``kill``, ``send``, ``recv``,
+``FedObject``.
+"""
+
+from rayfed_tpu.api import init, shutdown, remote, get, kill
+from rayfed_tpu.fed_object import FedObject
+from rayfed_tpu.proxy import send, recv
+from rayfed_tpu import tree_util
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "remote",
+    "get",
+    "kill",
+    "send",
+    "recv",
+    "FedObject",
+    "tree_util",
+    "__version__",
+]

@@ -1,0 +1,364 @@
+"""Public API: ``init`` / ``shutdown`` / ``remote`` / ``get`` / ``kill``.
+
+Capability parity with reference ``fed/api.py``, redesigned for a
+single-controller-per-party TPU runtime: ``init`` stands up the party's
+Runtime (executor + transport proxies + cleanup watchdog + optional local
+device mesh) instead of a Ray cluster; config lives on the Runtime rather
+than a GCS KV; ``@remote`` tasks dispatch to (optionally jit-compiled) JAX
+callables on the party's devices.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import logging
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from rayfed_tpu import utils as fed_utils
+from rayfed_tpu.actor import FedActorHandle
+from rayfed_tpu.call_holder import FedCallHolder
+from rayfed_tpu.cleanup import CleanupManager
+from rayfed_tpu.config import (
+    DEFAULT_MAX_MESSAGE_SIZE,
+    ClusterConfig,
+    JobConfig,
+    PartyConfig,
+    RetryPolicy,
+)
+from rayfed_tpu.executor import LocalRef, is_local_refs
+from rayfed_tpu.fed_object import FedObject
+from rayfed_tpu.runtime import (
+    Runtime,
+    get_runtime,
+    get_runtime_or_none,
+    set_current_runtime,
+)
+from rayfed_tpu.transport.manager import TransportManager
+from rayfed_tpu.utils.logging_utils import set_thread_party, setup_logger
+
+logger = logging.getLogger(__name__)
+
+
+def init(
+    address: Optional[str] = None,
+    cluster: Optional[Dict] = None,
+    party: Optional[str] = None,
+    tls_config: Optional[Dict] = None,
+    logging_level: str = "info",
+    cross_silo_retry_policy: Optional[Dict] = None,
+    cross_silo_grpc_retry_policy: Optional[Dict] = None,  # reference-compat alias
+    cross_silo_send_max_retries: Optional[int] = None,
+    cross_silo_serializing_allowed_list: Optional[Dict] = None,
+    exit_on_failure_cross_silo_sending: bool = False,
+    cross_silo_messages_max_size_in_bytes: Optional[int] = None,
+    cross_silo_timeout_in_seconds: float = 60,
+    enable_waiting_for_other_parties_ready: bool = False,
+    global_metadata: Optional[Dict] = None,
+    grpc_metadata: Optional[Dict] = None,  # reference-compat alias
+    mesh: Optional[Any] = None,
+    mesh_shape: Optional[Dict[str, int]] = None,
+    max_workers: int = 16,
+    device_put_received: bool = True,
+    process_default: bool = True,
+    **kwargs,
+) -> Runtime:
+    """Initialize this party's controller.
+
+    Reference-parity arguments follow ``fed/api.py:38-228``; the cluster
+    dict has the same shape (``address``, optional ``listen_addr``,
+    per-party ``metadata``/``grpc_metadata`` and
+    ``transport_options``/``grpc_options``).  ``address`` exists for
+    drop-in compat and accepts 'local'/None — there is no external cluster
+    to join: the controller process *is* the party runtime.
+
+    TPU-native arguments:
+
+    - ``mesh``: a ``jax.sharding.Mesh`` for this party's devices, or
+    - ``mesh_shape``: e.g. ``{'dp': 2, 'tp': 4}`` to build one over the
+      locally visible devices (see :mod:`rayfed_tpu.parallel.mesh`);
+    - ``device_put_received``: place received array payloads onto local
+      devices eagerly;
+    - ``process_default``: also register this runtime as the process-wide
+      default (disable when simulating multiple parties in one process).
+    """
+    assert cluster, "Cluster should be provided."
+    assert party, "Party should be provided."
+    assert party in cluster, f"Party {party} is not in cluster {cluster}."
+
+    fed_utils.validate_address(address)
+    fed_utils.validate_cluster_info(cluster)
+
+    tls_config = tls_config or None
+    if tls_config:
+        from rayfed_tpu.transport.tls import validate_tls_config
+
+        validate_tls_config(tls_config)
+
+    retry_dict = cross_silo_retry_policy or cross_silo_grpc_retry_policy
+    retry_policy = RetryPolicy.from_dict(retry_dict)
+    if cross_silo_send_max_retries is not None:
+        retry_policy.max_attempts = int(cross_silo_send_max_retries)
+
+    cluster_config = ClusterConfig(
+        parties={p: PartyConfig.from_dict(cfg) for p, cfg in cluster.items()},
+        current_party=party,
+        tls_config=tls_config,
+        serializing_allowed_list=cross_silo_serializing_allowed_list,
+    )
+    job_config = JobConfig(
+        cross_silo_timeout_s=float(cross_silo_timeout_in_seconds),
+        cross_silo_messages_max_size=(
+            int(cross_silo_messages_max_size_in_bytes)
+            if cross_silo_messages_max_size_in_bytes is not None
+            else DEFAULT_MAX_MESSAGE_SIZE
+        ),
+        retry_policy=retry_policy,
+        metadata=dict(global_metadata or grpc_metadata or {}),
+        exit_on_failure_sending=exit_on_failure_cross_silo_sending,
+        wait_for_ready=enable_waiting_for_other_parties_ready,
+        device_put_received=device_put_received,
+    )
+
+    if mesh is None and mesh_shape is not None:
+        from rayfed_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh(mesh_shape)
+
+    runtime = Runtime(
+        cluster_config=cluster_config,
+        job_config=job_config,
+        max_workers=max_workers,
+        mesh=mesh,
+    )
+    set_current_runtime(runtime, process_default=process_default)
+    set_thread_party(party)
+
+    setup_logger(logging_level=logging_level, party=party)
+
+    runtime.cleanup_manager = CleanupManager(
+        exit_on_failure_sending=exit_on_failure_cross_silo_sending
+    )
+    runtime.cleanup_manager.start()
+
+    transport = TransportManager(cluster_config, job_config)
+    transport.start()
+    runtime.send_proxy = transport
+    runtime.recv_proxy = transport
+    runtime.transport = transport
+
+    if enable_waiting_for_other_parties_ready:
+        ping_others(cluster=cluster, self_party=party, max_retries=3600)
+    logger.info("Started rayfed_tpu runtime for party %s.", party)
+    return runtime
+
+
+def ping_others(cluster: Dict[str, Dict], self_party: str, max_retries: int = 3600):
+    """Ping other parties until all are ready (ref ``barriers.py:441-466``)."""
+    runtime = get_runtime()
+    transport: TransportManager = runtime.transport
+    others = [p for p in cluster if p != self_party]
+    tried = 0
+    while tried < max_retries and others:
+        logger.info(
+            "Try ping %s at attempt %d, up to %d attempts.", others, tried, max_retries
+        )
+        tried += 1
+        others = [o for o in others if not transport.ping(o, timeout_s=1.0)]
+        if others:
+            time.sleep(2)
+    if others:
+        raise RuntimeError(
+            f"Failed to wait for parties: {others} to start, abort `fed.init`."
+        )
+    return True
+
+
+def shutdown() -> None:
+    """Shutdown this party's runtime (ref ``api.py:231-241``)."""
+    runtime = get_runtime_or_none()
+    if runtime is None:
+        return
+    if runtime.cleanup_manager is not None:
+        runtime.cleanup_manager.wait_sending()
+    if getattr(runtime, "transport", None) is not None:
+        runtime.transport.stop()
+    runtime.shutdown_actors()
+    runtime.executor.shutdown(wait=False)
+    set_current_runtime(None)
+    set_thread_party(None)
+    logger.info("Shutdowned rayfed_tpu.")
+
+
+def _get_cluster():
+    return get_runtime().cluster_config.cluster_addresses
+
+
+def _get_party():
+    return get_runtime().party
+
+
+def _get_tls():
+    return get_runtime().cluster_config.tls_config
+
+
+class FedRemoteFunction:
+    def __init__(self, func_or_class) -> None:
+        self._node_party: Optional[str] = None
+        self._func_body = func_or_class
+        self._options: dict = {}
+        self._fed_call_holder: Optional[FedCallHolder] = None
+
+    def party(self, party: str) -> "FedRemoteFunction":
+        self._node_party = party
+        self._fed_call_holder = FedCallHolder(
+            get_runtime(), self._node_party, self._execute_impl, self._options
+        )
+        return self
+
+    def options(self, **options) -> "FedRemoteFunction":
+        self._options = options
+        if self._fed_call_holder:
+            self._fed_call_holder.options(**options)
+        return self
+
+    def remote(self, *args, **kwargs):
+        assert (
+            self._node_party is not None
+        ), "A fed function should be specified within a party to execute."
+        return self._fed_call_holder.internal_remote(*args, **kwargs)
+
+    def _execute_impl(self, args: tuple, kwargs: dict):
+        runtime = get_runtime()
+        num_returns = int(self._options.get("num_returns", 1))
+        return runtime.executor.submit(
+            self._func_body, args, kwargs, num_returns=num_returns
+        )
+
+
+class FedRemoteClass:
+    def __init__(self, func_or_class) -> None:
+        self._party: Optional[str] = None
+        self._cls = func_or_class
+        self._options: dict = {}
+
+    def party(self, party: str) -> "FedRemoteClass":
+        self._party = party
+        return self
+
+    def options(self, **options) -> "FedRemoteClass":
+        self._options = options
+        return self
+
+    def remote(self, *cls_args, **cls_kwargs) -> FedActorHandle:
+        runtime = get_runtime()
+        fed_class_task_id = runtime.next_seq_id()
+        fed_actor_handle = FedActorHandle(
+            runtime,
+            fed_class_task_id,
+            self._cls,
+            self._party,
+            self._options,
+        )
+        fed_call_holder = FedCallHolder(
+            runtime, self._party, fed_actor_handle._execute_impl, self._options
+        )
+        fed_call_holder.internal_remote(*cls_args, **cls_kwargs)
+        return fed_actor_handle
+
+
+def remote(*args, **kwargs):
+    """``@fed.remote`` decorator for functions and classes (ref ``api.py:332-350``)."""
+
+    def _make_fed_remote(function_or_class, **options):
+        if inspect.isfunction(function_or_class) or inspect.isbuiltin(
+            function_or_class
+        ):
+            return FedRemoteFunction(function_or_class).options(**options)
+        if inspect.isclass(function_or_class):
+            return FedRemoteClass(function_or_class).options(**options)
+        raise TypeError(
+            "The @fed.remote decorator must be applied to either a function or a class."
+        )
+
+    if len(args) == 1 and len(kwargs) == 0 and callable(args[0]):
+        return _make_fed_remote(args[0])
+    assert len(args) == 0 and len(kwargs) > 0, "Remote args error."
+    return functools.partial(_make_fed_remote, **kwargs)
+
+
+def get(
+    fed_objects: Union[LocalRef, FedObject, List[FedObject]],
+    timeout: Optional[float] = None,
+) -> Any:
+    """Fetch real data of fed objects (ref ``api.py:353-421``).
+
+    Owned objects are broadcast (pushed) to every other party not already
+    holding them; unowned objects park on a recv keyed by the shared fake
+    seq id allocated identically on all parties.
+    """
+    if is_local_refs(fed_objects):
+        if isinstance(fed_objects, list):
+            return [r.resolve(timeout=timeout) for r in fed_objects]
+        return fed_objects.resolve(timeout=timeout)
+
+    runtime = get_runtime()
+    from rayfed_tpu.proxy import recv_on_runtime, send_on_runtime
+
+    # Fake fed_task_id allocated on EVERY party to keep counters aligned
+    # (ref api.py:368) — the determinism contract.
+    fake_fed_task_id = runtime.next_seq_id()
+    cluster_parties = list(runtime.cluster_config.parties)
+    current_party = runtime.party
+    is_individual_id = isinstance(fed_objects, FedObject)
+    if is_individual_id:
+        fed_objects = [fed_objects]
+
+    refs: List[LocalRef] = []
+    for fed_object in fed_objects:
+        if isinstance(fed_object, LocalRef):
+            refs.append(fed_object)
+            continue
+        if fed_object.get_party() == current_party:
+            local_ref = fed_object.get_local_ref()
+            assert local_ref is not None
+            refs.append(local_ref)
+            for party_name in cluster_parties:
+                if party_name == current_party:
+                    continue
+                # Exactly-once broadcast dedup (ref api.py:389-394).
+                if fed_object._mark_if_not_sending_to_party(party_name):
+                    send_on_runtime(
+                        runtime,
+                        dest_party=party_name,
+                        data=local_ref,
+                        upstream_seq_id=fed_object.get_fed_task_id(),
+                        downstream_seq_id=fake_fed_task_id,
+                    )
+        else:
+            cached = fed_object.get_local_ref()
+            if cached is not None:
+                refs.append(cached)
+            else:
+                received = recv_on_runtime(
+                    runtime,
+                    src_party=fed_object.get_party(),
+                    upstream_seq_id=fed_object.get_fed_task_id(),
+                    curr_seq_id=fake_fed_task_id,
+                )
+                fed_object._cache_local_ref(received)
+                refs.append(received)
+
+    values = [r.resolve(timeout=timeout) for r in refs]
+    if is_individual_id:
+        values = values[0]
+    return values
+
+
+def kill(actor: FedActorHandle, *, no_restart: bool = True) -> None:
+    """Kill a fed actor — only effective in its owning party (ref ``api.py:424-428``)."""
+    del no_restart  # no restart semantics in the in-process substrate
+    runtime = get_runtime()
+    if actor._node_party == runtime.party:
+        actor._kill()

@@ -1,0 +1,112 @@
+"""Cluster / job configuration.
+
+The reference round-trips two cloudpickled dicts through Ray's internal KV
+(``fed/api.py:179-195`` → ``fed/config.py:54-79``) because its proxies live
+in separate Ray worker processes.  Our process model is one controller per
+party, so config is a plain in-process struct attached to the Runtime; the
+*shape* of the config (cluster addresses, per-party overrides, TLS, retry
+policy, serialization allowlist, message caps, timeouts) is preserved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+DEFAULT_MAX_MESSAGE_SIZE = 500 * 1024 * 1024  # parity: grpc_options.py:27-28
+DEFAULT_CROSS_SILO_TIMEOUT_S = 60  # parity: api.py:49
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Client retry policy for cross-silo sends.
+
+    Defaults mirror the reference's gRPC service config
+    (``fed/_private/grpc_options.py:17-23``): 5 attempts, 5s initial
+    backoff, 30s max, ×2 multiplier, retry on transport unavailability.
+    """
+
+    max_attempts: int = 5
+    initial_backoff_s: float = 5.0
+    max_backoff_s: float = 30.0
+    backoff_multiplier: float = 2.0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RetryPolicy":
+        if not d:
+            return cls()
+
+        def _dur(v, default):
+            # Accept gRPC-style "5s" strings for drop-in compat.
+            if v is None:
+                return default
+            if isinstance(v, str) and v.endswith("s"):
+                return float(v[:-1])
+            return float(v)
+
+        return cls(
+            max_attempts=int(d.get("maxAttempts", d.get("max_attempts", 5))),
+            initial_backoff_s=_dur(
+                d.get("initialBackoff", d.get("initial_backoff_s")), 5.0
+            ),
+            max_backoff_s=_dur(d.get("maxBackoff", d.get("max_backoff_s")), 30.0),
+            backoff_multiplier=float(
+                d.get("backoffMultiplier", d.get("backoff_multiplier", 2.0))
+            ),
+        )
+
+
+@dataclasses.dataclass
+class PartyConfig:
+    """Per-party entry in the cluster map (reference ``api.py:61-96``)."""
+
+    address: str
+    listen_addr: Optional[str] = None  # bind addr if different from advertised
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+    transport_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PartyConfig":
+        return cls(
+            address=d["address"],
+            listen_addr=d.get("listen_addr"),
+            metadata=dict(d.get("metadata") or d.get("grpc_metadata") or {}),
+            transport_options=dict(
+                d.get("transport_options") or d.get("grpc_options") or {}
+            ),
+        )
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """Resolved cluster topology + security config for one job."""
+
+    parties: Dict[str, PartyConfig]
+    current_party: str
+    tls_config: Optional[Dict[str, str]] = None
+    serializing_allowed_list: Optional[Dict[str, Any]] = None
+
+    @property
+    def cluster_addresses(self) -> Dict[str, str]:
+        return {p: c.address for p, c in self.parties.items()}
+
+    def other_parties(self) -> List[str]:
+        return [p for p in self.parties if p != self.current_party]
+
+    def party_config(self, party: str) -> PartyConfig:
+        return self.parties[party]
+
+
+@dataclasses.dataclass
+class JobConfig:
+    """Job-wide knobs (reference ``fed/config.py:17-51``)."""
+
+    cross_silo_timeout_s: float = DEFAULT_CROSS_SILO_TIMEOUT_S
+    cross_silo_messages_max_size: int = DEFAULT_MAX_MESSAGE_SIZE
+    retry_policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+    exit_on_failure_sending: bool = False
+    wait_for_ready: bool = False
+    # TPU-native: put received array payloads on local devices eagerly.
+    device_put_received: bool = True

@@ -1,0 +1,275 @@
+"""In-party execution substrate — replaces Ray tasks/actors/object store.
+
+The reference delegates local execution to Ray (``fed/api.py:294-297``,
+``fed/_private/fed_actor.py:66-70``): every ``fed.remote`` call becomes a
+``ray.remote`` task in a worker *process*, and values flow through the
+plasma object store.  On TPU that model is wrong: a party owns exactly one
+set of local devices, the expensive work is XLA-compiled computation whose
+dispatch is already asynchronous, and moving arrays through an object store
+would force device→host copies.
+
+So the substrate here is deliberately in-process:
+
+- :class:`LocalRef` — the in-party future (replaces ``ray.ObjectRef``).
+- :class:`TaskExecutor` — a thread pool that resolves *top-level* LocalRef
+  arguments to values and invokes the (usually jit-compiled) callable.
+  JAX owns device parallelism; threads only overlap host work, transfers
+  and dispatch.  Nested LocalRefs inside containers are passed through
+  un-resolved, matching Ray's argument semantics that the reference relies
+  on (see ``tests/test_pass_fed_objects_in_containers_in_normal_tasks.py``
+  in the reference: the consumer calls ``fed.get`` inside the task body).
+- :class:`ActorInstance` — a stateful object bound to a single-thread
+  executor, so method calls execute serially in submission order (Ray
+  actor semantics without a process boundary).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class LocalRef:
+    """A future for a value produced inside this party.
+
+    Wraps :class:`concurrent.futures.Future`.  ``resolve()`` blocks until
+    the value is available (the analogue of ``ray.get`` on an ObjectRef).
+    """
+
+    __slots__ = ("_future",)
+
+    def __init__(self, future: Optional[concurrent.futures.Future] = None) -> None:
+        self._future = future if future is not None else concurrent.futures.Future()
+
+    @classmethod
+    def from_value(cls, value: Any) -> "LocalRef":
+        ref = cls()
+        ref._future.set_result(value)
+        return ref
+
+    def resolve(self, timeout: Optional[float] = None) -> Any:
+        return self._future.result(timeout=timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def exception(self, timeout: Optional[float] = None):
+        return self._future.exception(timeout=timeout)
+
+    def set_result(self, value: Any) -> None:
+        self._future.set_result(value)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._future.set_exception(exc)
+
+    def add_done_callback(self, fn: Callable[["LocalRef"], None]) -> None:
+        self._future.add_done_callback(lambda _f: fn(self))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"LocalRef(done={self._future.done()})"
+
+
+def resolve_local_refs(refs: Sequence[LocalRef], timeout: Optional[float] = None):
+    return [r.resolve(timeout=timeout) for r in refs]
+
+
+def is_local_ref(obj: Any) -> bool:
+    return isinstance(obj, LocalRef)
+
+
+def is_local_refs(objects: Any) -> bool:
+    """True if ``objects`` is a LocalRef or a non-empty list of LocalRefs.
+
+    Parity with reference ``fed/utils.py:64-74`` (``is_ray_object_refs``)
+    used for the ``fed.get`` passthrough path.
+    """
+    if isinstance(objects, LocalRef):
+        return True
+    if isinstance(objects, list) and objects:
+        return all(isinstance(o, LocalRef) for o in objects)
+    return False
+
+
+def _materialize_arg(arg: Any) -> Any:
+    """Resolve a *top-level* argument if it is a LocalRef.
+
+    Containers are not traversed: a LocalRef nested inside a list stays a
+    LocalRef, which the task body resolves via ``fed.get`` (matches Ray's
+    top-level-only ObjectRef resolution that the reference depends on).
+    """
+    if isinstance(arg, LocalRef):
+        return arg.resolve()
+    return arg
+
+
+class TaskExecutor:
+    """Thread-pool dispatch of party-local work.
+
+    ``bind_runtime_fn`` is called in each worker thread before executing a
+    task body so that ``fed.*`` calls made *inside* tasks see the right
+    per-party runtime (required for multi-party-in-one-process simulation
+    and for ``fed.get`` inside task bodies).
+    """
+
+    def __init__(
+        self,
+        max_workers: int = 16,
+        thread_name_prefix: str = "rayfed-worker",
+        bind_runtime_fn: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix=thread_name_prefix
+        )
+        self._bind_runtime_fn = bind_runtime_fn
+        self._shutdown = False
+
+    def submit(
+        self,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+    ):
+        """Submit ``fn(*args, **kwargs)``; returns LocalRef or list of them."""
+        if self._shutdown:
+            raise RuntimeError("TaskExecutor has been shut down")
+
+        def _run():
+            if self._bind_runtime_fn is not None:
+                self._bind_runtime_fn()
+            resolved_args = tuple(_materialize_arg(a) for a in args)
+            resolved_kwargs = {k: _materialize_arg(v) for k, v in kwargs.items()}
+            return fn(*resolved_args, **resolved_kwargs)
+
+        future = self._pool.submit(_run)
+        if num_returns == 1:
+            return LocalRef(future)
+        return _split_future(future, num_returns)
+
+    def submit_resolved(self, fn: Callable, *args, **kwargs) -> LocalRef:
+        """Submit without argument materialization (internal use)."""
+
+        def _run():
+            if self._bind_runtime_fn is not None:
+                self._bind_runtime_fn()
+            return fn(*args, **kwargs)
+
+        return LocalRef(self._pool.submit(_run))
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._shutdown = True
+        self._pool.shutdown(wait=wait)
+
+
+def _split_future(
+    future: concurrent.futures.Future, num_returns: int
+) -> list[LocalRef]:
+    """Fan a single future producing a sequence into ``num_returns`` refs."""
+    children = [LocalRef() for _ in range(num_returns)]
+
+    def _distribute(parent: concurrent.futures.Future) -> None:
+        exc = parent.exception()
+        if exc is not None:
+            for child in children:
+                child.set_exception(exc)
+            return
+        values = parent.result()
+        try:
+            values = list(values)
+        except TypeError:
+            for child in children:
+                child.set_exception(
+                    TypeError(
+                        f"task declared num_returns={num_returns} but returned "
+                        f"non-iterable {type(values).__name__}"
+                    )
+                )
+            return
+        if len(values) != num_returns:
+            for child in children:
+                child.set_exception(
+                    ValueError(
+                        f"task declared num_returns={num_returns} but returned "
+                        f"{len(values)} values"
+                    )
+                )
+            return
+        for child, value in zip(children, values):
+            child.set_result(value)
+
+    future.add_done_callback(_distribute)
+    return children
+
+
+class ActorInstance:
+    """A party-local stateful actor: one object + one serial executor.
+
+    Method calls run one-at-a-time in submission order on a dedicated
+    thread, reproducing Ray's default actor concurrency semantics.  State
+    (e.g. sharded model params as ``jax.Array``s) stays on-device between
+    calls — no object-store round trips.
+    """
+
+    def __init__(
+        self,
+        cls: type,
+        cls_args: tuple,
+        cls_kwargs: dict,
+        bind_runtime_fn: Optional[Callable[[], None]] = None,
+        name: str = "actor",
+    ) -> None:
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"rayfed-actor-{name}"
+        )
+        self._bind_runtime_fn = bind_runtime_fn
+        self._instance: Any = None
+        self._killed = False
+        self._lock = threading.Lock()
+
+        def _construct():
+            if self._bind_runtime_fn is not None:
+                self._bind_runtime_fn()
+            resolved_args = tuple(_materialize_arg(a) for a in cls_args)
+            resolved_kwargs = {k: _materialize_arg(v) for k, v in cls_kwargs.items()}
+            self._instance = cls(*resolved_args, **resolved_kwargs)
+            return None
+
+        self._ready_ref = LocalRef(self._pool.submit(_construct))
+
+    @property
+    def ready_ref(self) -> LocalRef:
+        return self._ready_ref
+
+    def call_method(
+        self, method_name: str, args: tuple, kwargs: dict, num_returns: int = 1
+    ):
+        with self._lock:
+            if self._killed:
+                raise RuntimeError("actor has been killed")
+
+            def _run():
+                if self._bind_runtime_fn is not None:
+                    self._bind_runtime_fn()
+                # Surface constructor failure on first method call.
+                self._ready_ref.resolve()
+                resolved_args = tuple(_materialize_arg(a) for a in args)
+                resolved_kwargs = {
+                    k: _materialize_arg(v) for k, v in kwargs.items()
+                }
+                method = getattr(self._instance, method_name)
+                return method(*resolved_args, **resolved_kwargs)
+
+            future = self._pool.submit(_run)
+        if num_returns == 1:
+            return LocalRef(future)
+        return _split_future(future, num_returns)
+
+    def kill(self) -> None:
+        with self._lock:
+            self._killed = True
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._instance = None

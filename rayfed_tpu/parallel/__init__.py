@@ -1,0 +1,31 @@
+"""Party-local parallelism: meshes, sharding strategies, collectives.
+
+The reference has **no** intra-party parallelism (SURVEY §2.10) — whatever
+the user's TF/Torch code did inside a Ray task.  Here it is first-class:
+each party owns a `jax.sharding.Mesh` over its local TPU slice, fed tasks
+carry a :class:`~rayfed_tpu.parallel.sharding.ShardingStrategy` describing
+how their compute maps onto the mesh axes (DP / FSDP / TP / SP / EP / PP),
+and cross-party aggregation composes with intra-party XLA collectives.
+"""
+
+from rayfed_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    create_mesh,
+)
+from rayfed_tpu.parallel.sharding import ShardingStrategy
+
+__all__ = [
+    "create_mesh",
+    "ShardingStrategy",
+    "AXIS_DP",
+    "AXIS_FSDP",
+    "AXIS_TP",
+    "AXIS_SP",
+    "AXIS_EP",
+    "AXIS_PP",
+]

@@ -1,0 +1,83 @@
+"""Device-mesh construction for a party's local TPU slice.
+
+Axis-name conventions used across the framework (models, sharding
+strategies, ring attention, pipeline):
+
+- ``dp``   — data parallel (batch split; gradients all-reduced)
+- ``fsdp`` — fully-sharded data parallel (params sharded over this axis)
+- ``tp``   — tensor/model parallel (matmul contracting or feature dims)
+- ``sp``   — sequence/context parallel (ring attention / Ulysses)
+- ``ep``   — expert parallel (MoE experts spread over this axis)
+- ``pp``   — pipeline parallel (layer stages)
+
+``create_mesh({'dp': 2, 'tp': 4})`` builds a Mesh over the locally visible
+devices.  A trailing axis may be -1 to absorb the remaining devices.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+AXIS_PP = "pp"
+
+STANDARD_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_EP, AXIS_PP)
+
+
+def create_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named Mesh over this party's devices.
+
+    ``shape`` maps axis name → size, in the order given (insertion order is
+    the device-grid order — put the most-communicating axis last so it
+    lands on the innermost/fastest ICI dimension).  One axis may be -1.
+    With ``shape=None`` the mesh is 1-D data-parallel over all devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if not shape:
+        shape = {AXIS_DP: n}
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if known <= 0 or n % known:
+            raise ValueError(
+                f"cannot infer -1 axis: {n} devices not divisible by {known}"
+            )
+        sizes[sizes.index(-1)] = n // known
+    total = math.prod(sizes)
+    if total != n:
+        raise ValueError(
+            f"mesh shape {dict(zip(names, sizes))} requires {total} devices, "
+            f"but {n} are visible"
+        )
+    grid = np.asarray(devices).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """A 1×… mesh for one device — lets sharded code paths run unchanged."""
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.asarray([device]), axis_names=(AXIS_DP,))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") else dict(
+        zip(mesh.axis_names, mesh.devices.shape)
+    ).get(axis, 1)

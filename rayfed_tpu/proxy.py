@@ -1,0 +1,60 @@
+"""Module-level ``send`` / ``recv`` primitives.
+
+Parity with reference ``fed/barriers.py:418-438``: ``send`` routes through
+the party's send proxy and registers the in-flight result with the cleanup
+watchdog; ``recv`` returns a future that parks until the owner's push
+arrives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from rayfed_tpu.executor import LocalRef
+from rayfed_tpu.runtime import Runtime, get_runtime
+
+
+def send_on_runtime(
+    runtime: Runtime,
+    dest_party: str,
+    data: Any,
+    upstream_seq_id: Any,
+    downstream_seq_id: Any,
+) -> LocalRef:
+    if runtime.send_proxy is None:
+        raise RuntimeError("transport not started; call fed.init() first")
+    result_ref = runtime.send_proxy.send(
+        dest_party=dest_party,
+        data=data,
+        upstream_seq_id=upstream_seq_id,
+        downstream_seq_id=downstream_seq_id,
+    )
+    if runtime.cleanup_manager is not None:
+        runtime.cleanup_manager.push_to_sending(result_ref)
+    return result_ref
+
+
+def recv_on_runtime(
+    runtime: Runtime,
+    src_party: str,
+    upstream_seq_id: Any,
+    curr_seq_id: Any,
+) -> LocalRef:
+    if runtime.recv_proxy is None:
+        raise RuntimeError("transport not started; call fed.init() first")
+    return runtime.recv_proxy.recv(
+        src_party=src_party,
+        upstream_seq_id=upstream_seq_id,
+        downstream_seq_id=curr_seq_id,
+    )
+
+
+def send(dest_party: str, data: Any, upstream_seq_id: Any, downstream_seq_id: Any):
+    return send_on_runtime(
+        get_runtime(), dest_party, data, upstream_seq_id, downstream_seq_id
+    )
+
+
+def recv(party: str, src_party: str, upstream_seq_id: Any, curr_seq_id: Any):
+    assert party, "Party can not be None."
+    return recv_on_runtime(get_runtime(), src_party, upstream_seq_id, curr_seq_id)

@@ -1,0 +1,88 @@
+"""Restricted deserialization — anti-pickle-attack allowlist.
+
+Capability parity with reference ``fed/_private/serialization_utils.py``:
+cross-silo payload bytes are untrusted, so any pickled sub-payload is
+deserialized through a :class:`RestrictedUnpickler` whose ``find_class``
+only admits allowlisted modules/classes.  The allowlist format matches the
+reference (``serialization_utils.py:63-77``): a dict mapping module name →
+list of attribute names, with ``"*"`` admitting every attribute of the
+module, e.g. ``{"numpy": ["float64"], "pandas": "*"}``.
+
+Unlike the reference (which monkey-patches ``cloudpickle.loads`` inside the
+recv proxy, ``barriers.py:342-345``), the allowlist here is threaded
+explicitly through the wire codec — no global mutation, safe with multiple
+in-process parties.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+# Internal types the wire codec itself needs; always admitted.
+_INTERNAL_ALLOWED = {
+    ("rayfed_tpu.transport.wire", "_Skeleton"),
+    ("rayfed_tpu.transport.wire", "_LeafSlot"),
+}
+
+
+def _compose_whitelist(allowed: Dict[str, Any]) -> tuple[set, set]:
+    """Returns (exact {(module, name)}, wildcard {module})."""
+    exact: set = set()
+    wildcard: set = set()
+    for module, names in (allowed or {}).items():
+        if names == "*" or names is None:
+            wildcard.add(module)
+            continue
+        if isinstance(names, str):
+            names = [names]
+        for name in names:
+            if name == "*":
+                wildcard.add(module)
+            else:
+                exact.add((module, name))
+    return exact, wildcard
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    def __init__(self, file, allowed: Dict[str, Any], **kw) -> None:
+        super().__init__(file, **kw)
+        self._exact, self._wildcard = _compose_whitelist(allowed)
+
+    def find_class(self, module: str, name: str):
+        if (module, name) in _INTERNAL_ALLOWED:
+            return super().find_class(module, name)
+        if (module, name) in self._exact:
+            return super().find_class(module, name)
+        # Wildcard admits the module and any of its submodules
+        # (reference admits e.g. "numpy.core.numeric" under "numpy": "*").
+        parts = module.split(".")
+        for i in range(len(parts), 0, -1):
+            if ".".join(parts[:i]) in self._wildcard:
+                return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"global '{module}.{name}' is forbidden by the serializing allowed list"
+        )
+
+
+def restricted_loads(data: bytes, allowed: Dict[str, Any]) -> Any:
+    return RestrictedUnpickler(io.BytesIO(data), allowed).load()
+
+
+def loads(data: bytes, allowed: Optional[Dict[str, Any]] = None) -> Any:
+    """Deserialize with the allowlist if one is configured, else plain loads.
+
+    Matches reference behavior: the restriction is applied only when
+    ``serializing_allowed_list`` was passed to ``fed.init``
+    (``barriers.py:342-345``).
+    """
+    if allowed:
+        return restricted_loads(data, allowed)
+    return cloudpickle.loads(data)
+
+
+def dumps(obj: Any) -> bytes:
+    return cloudpickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)

@@ -1,0 +1,226 @@
+"""Transport client — persistent, multiplexed, retrying connection per peer.
+
+Plays the role of the reference's ``send_data_grpc`` channel
+(``barriers.py:121-181``) plus its gRPC service-config retry policy
+(``grpc_options.py:17-23``): attempts with exponential backoff on
+transport unavailability, a per-RPC deadline, per-party metadata headers,
+and a message-size cap.  One connection per destination party carries
+pipelined DATA frames; ACKs are matched by request id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import ssl
+from typing import Any, Dict, List, Optional
+
+from rayfed_tpu.config import RetryPolicy
+from rayfed_tpu.transport import wire
+
+logger = logging.getLogger(__name__)
+
+
+class SendError(ConnectionError):
+    pass
+
+
+class FatalSendError(SendError):
+    """A send rejected by the peer for a non-transient reason — not retried."""
+
+
+class TransportClient:
+    def __init__(
+        self,
+        src_party: str,
+        dest_party: str,
+        address: str,
+        retry_policy: RetryPolicy,
+        timeout_s: float,
+        max_message_size: int,
+        metadata: Optional[Dict[str, str]] = None,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        server_hostname: Optional[str] = None,
+    ) -> None:
+        self._src_party = src_party
+        self._dest_party = dest_party
+        host, _, port = address.rpartition(":")
+        self._host = host
+        self._port = int(port)
+        self._retry_policy = retry_policy
+        self._timeout_s = timeout_s
+        self._max_message_size = max_message_size
+        self._metadata = dict(metadata or {})
+        self._ssl_context = ssl_context
+        self._server_hostname = server_hostname
+        self._rid = itertools.count(1)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._conn_lock = asyncio.Lock()
+        self._write_lock = asyncio.Lock()
+
+    # -- connection management ------------------------------------------------
+
+    async def _ensure_connected(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            reader, writer = await asyncio.open_connection(
+                self._host,
+                self._port,
+                ssl=self._ssl_context,
+                server_hostname=self._server_hostname if self._ssl_context else None,
+                limit=2**20,
+            )
+            self._reader = reader
+            self._writer = writer
+            self._reader_task = asyncio.ensure_future(self._read_responses(reader))
+
+    async def _read_responses(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                prefix = await reader.readexactly(wire.HEADER_SIZE)
+                msg_type, _flags, hlen, plen = wire.unpack_frame_prefix(prefix)
+                header = json.loads(await reader.readexactly(hlen)) if hlen else {}
+                if plen:
+                    await reader.readexactly(plen)
+                rid = header.get("rid")
+                fut = self._pending.pop(rid, None)
+                if fut is None or fut.done():
+                    continue
+                if msg_type == wire.MSG_ERR:
+                    exc_cls = FatalSendError if header.get("fatal") else SendError
+                    fut.set_exception(exc_cls(header.get("error", "remote error")))
+                else:
+                    fut.set_result(header)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError) as e:
+            self._fail_pending(SendError(f"connection to {self._dest_party} lost: {e}"))
+        except asyncio.CancelledError:
+            self._fail_pending(SendError("client shutting down"))
+            raise
+
+    def _fail_pending(self, exc: Exception) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._writer = None
+        self._reader = None
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader_task = None
+        self._fail_pending(SendError("client closed"))
+
+    # -- RPCs -----------------------------------------------------------------
+
+    async def _roundtrip(
+        self, msg_type: int, header: Dict[str, Any], payload_bufs: List
+    ) -> Dict[str, Any]:
+        await self._ensure_connected()
+        rid = next(self._rid)
+        header = dict(header, rid=rid)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending[rid] = fut
+        payload_len = wire.payload_nbytes(payload_bufs)
+        try:
+            async with self._write_lock:
+                assert self._writer is not None
+                for buf in wire.pack_frame(msg_type, header,
+                                           payload_len=payload_len):
+                    self._writer.write(buf)
+                for buf in payload_bufs:
+                    self._writer.write(buf)
+                await self._writer.drain()
+            return await asyncio.wait_for(fut, timeout=self._timeout_s)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+            self._pending.pop(rid, None)
+            self._fail_pending(SendError(str(e)))
+            raise SendError(str(e)) from e
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            raise
+
+    async def send_data(
+        self,
+        payload_bufs: List,
+        upstream_seq_id: str,
+        downstream_seq_id: str,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        """Push one DATA message with retry policy; returns the ACK result."""
+        payload_len = wire.payload_nbytes(payload_bufs)
+        if payload_len > self._max_message_size:
+            raise SendError(
+                f"message of {payload_len} bytes exceeds configured max "
+                f"{self._max_message_size}"
+            )
+        merged_meta = dict(self._metadata)
+        if metadata:
+            merged_meta.update(metadata)
+        header = {
+            "src": self._src_party,
+            "up": str(upstream_seq_id),
+            "down": str(downstream_seq_id),
+            "meta": merged_meta,
+        }
+        policy = self._retry_policy
+        backoff = policy.initial_backoff_s
+        last_exc: Optional[Exception] = None
+        for attempt in range(max(1, policy.max_attempts)):
+            if attempt:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * policy.backoff_multiplier,
+                              policy.max_backoff_s)
+            try:
+                ack = await self._roundtrip(wire.MSG_DATA, header, payload_bufs)
+                return ack.get("result", "OK")
+            except FatalSendError:
+                raise
+            except (SendError, OSError, ConnectionError) as e:
+                last_exc = e
+                logger.debug(
+                    "[%s] send to %s attempt %d/%d failed: %s",
+                    self._src_party, self._dest_party, attempt + 1,
+                    policy.max_attempts, e,
+                )
+            except asyncio.TimeoutError as e:
+                # Deadline exceeded is not retried (parity: only UNAVAILABLE
+                # is a retryable status in the reference policy).
+                raise SendError(
+                    f"send to {self._dest_party} timed out after "
+                    f"{self._timeout_s}s"
+                ) from e
+        raise SendError(
+            f"send to {self._dest_party} failed after "
+            f"{policy.max_attempts} attempts: {last_exc}"
+        )
+
+    async def ping(self, timeout_s: float = 1.0) -> bool:
+        try:
+            saved = self._timeout_s
+            self._timeout_s = timeout_s
+            try:
+                await self._roundtrip(wire.MSG_PING, {"src": self._src_party}, [])
+            finally:
+                self._timeout_s = saved
+            return True
+        except Exception:
+            return False

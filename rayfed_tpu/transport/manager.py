@@ -1,0 +1,273 @@
+"""TransportManager — send/recv proxies on one asyncio loop thread.
+
+The reference hosts its transport in two named Ray actors
+(``SendProxyActor`` / ``RecverProxyActor-{party}``, ``barriers.py:184-351``)
+with ``max_concurrency=1000`` so many ``get_data`` calls can park.  Our
+party controller is a single process, so both proxies live on one asyncio
+event loop running in a dedicated thread: thousands of pending recvs are
+just parked coroutines, and sends are pipelined frames — no actor
+round-trips, no object-store copies.
+
+Payload encode/decode runs on a small codec thread pool so the loop never
+blocks on serialization, and received device-array leaves are put back on
+local devices off-loop as well.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
+from rayfed_tpu.executor import LocalRef
+from rayfed_tpu.transport import tls as tls_utils
+from rayfed_tpu.transport import wire
+from rayfed_tpu.transport.client import SendError, TransportClient
+from rayfed_tpu.transport.rendezvous import Mailbox, Message
+from rayfed_tpu.transport.server import TransportServer
+
+logger = logging.getLogger(__name__)
+
+
+class TransportManager:
+    def __init__(
+        self,
+        cluster_config: ClusterConfig,
+        job_config: JobConfig,
+    ) -> None:
+        self._cluster = cluster_config
+        self._job = job_config
+        self._party = cluster_config.current_party
+
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+        self._mailbox = Mailbox()
+        my_cfg = cluster_config.party_config(self._party)
+        listen_addr = my_cfg.listen_addr or my_cfg.address
+        self._server = TransportServer(
+            party=self._party,
+            listen_addr=listen_addr,
+            mailbox=self._mailbox,
+            max_message_size=job_config.cross_silo_messages_max_size,
+            ssl_context=tls_utils.server_ssl_context(cluster_config.tls_config),
+        )
+        self._clients: Dict[str, TransportClient] = {}
+        self._clients_lock = threading.Lock()
+        self._codec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"rayfed-codec-{self._party}"
+        )
+        self.stats: Dict[str, Any] = {
+            "send_op_count": 0,
+            "send_bytes": 0,
+            "send_seconds": 0.0,
+        }
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        def _run_loop():
+            asyncio.set_event_loop(self._loop)
+            self._started.set()
+            self._loop.run_forever()
+
+        self._loop_thread = threading.Thread(
+            target=_run_loop, name=f"rayfed-transport-{self._party}", daemon=True
+        )
+        self._loop_thread.start()
+        self._started.wait()
+        # Synchronous barrier: listener must be up before init returns
+        # (parity with ray.get(actor.is_ready.remote()), barriers.py:379).
+        fut = asyncio.run_coroutine_threadsafe(self._server.start(), self._loop)
+        fut.result(timeout=30)
+
+    def stop(self) -> None:
+        async def _shutdown():
+            for client in self._clients.values():
+                await client.close()
+            await self._server.stop()
+            # Cancel parked recvs so shutdown doesn't leak pending tasks.
+            current = asyncio.current_task()
+            for task in asyncio.all_tasks():
+                if task is not current:
+                    task.cancel()
+
+        if self._loop_thread is None:
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(_shutdown(), self._loop).result(timeout=10)
+        except Exception:  # pragma: no cover
+            logger.exception("[%s] transport shutdown error", self._party)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=10)
+        self._loop.close()
+        self._loop_thread = None
+        self._codec_pool.shutdown(wait=False)
+
+    # -- client construction --------------------------------------------------
+
+    def _merged_options(self, dest_party: str) -> Dict[str, Any]:
+        """Per-destination options, per-party overriding global (ref :250-268)."""
+        opts: Dict[str, Any] = {
+            "timeout_s": self._job.cross_silo_timeout_s,
+            "max_message_size": self._job.cross_silo_messages_max_size,
+        }
+        party_opts = dict(self._cluster.party_config(dest_party).transport_options)
+        # Accept reference-style gRPC channel-arg keys for drop-in compat.
+        if "grpc.max_send_message_length" in party_opts:
+            opts["max_message_size"] = party_opts.pop("grpc.max_send_message_length")
+        party_opts.pop("grpc.default_authority", None)
+        opts.update(party_opts)
+        return opts
+
+    def merged_metadata(self, dest_party: str) -> Dict[str, str]:
+        meta = dict(self._job.metadata)
+        meta.update(self._cluster.party_config(dest_party).metadata)
+        return meta
+
+    def _get_client(self, dest_party: str) -> TransportClient:
+        # Called from codec-pool threads and ping callers concurrently.
+        with self._clients_lock:
+            client = self._clients.get(dest_party)
+            if client is None:
+                opts = self._merged_options(dest_party)
+                client = TransportClient(
+                    src_party=self._party,
+                    dest_party=dest_party,
+                    address=self._cluster.party_config(dest_party).address,
+                    retry_policy=self._job.retry_policy,
+                    timeout_s=float(opts["timeout_s"]),
+                    max_message_size=int(opts["max_message_size"]),
+                    metadata=self.merged_metadata(dest_party),
+                    ssl_context=tls_utils.client_ssl_context(self._cluster.tls_config),
+                )
+                self._clients[dest_party] = client
+            return client
+
+    # -- send path (SendProxy role) ------------------------------------------
+
+    def send(
+        self,
+        dest_party: str,
+        data: Any,
+        upstream_seq_id: Any,
+        downstream_seq_id: Any,
+    ) -> LocalRef:
+        """Owner-initiated push.  Returns a LocalRef resolving to True/False.
+
+        Failures are swallowed into ``False`` + a log line (parity:
+        ``barriers.py:244-248``); the cleanup watchdog turns persistent
+        failures into process exit when configured.
+        """
+        out_ref = LocalRef()
+        self.stats["send_op_count"] += 1
+
+        def _encode_and_send(value: Any) -> None:
+            try:
+                bufs = wire.encode_payload(value)
+                nbytes = wire.payload_nbytes(bufs)
+                t0 = time.perf_counter()
+                client = self._get_client(dest_party)
+                cf = asyncio.run_coroutine_threadsafe(
+                    client.send_data(bufs, str(upstream_seq_id),
+                                     str(downstream_seq_id)),
+                    self._loop,
+                )
+
+                def _done(f):
+                    try:
+                        f.result()
+                        self.stats["send_bytes"] += nbytes
+                        self.stats["send_seconds"] += time.perf_counter() - t0
+                        out_ref.set_result(True)
+                    except Exception as e:
+                        logger.warning(
+                            "[%s] failed to send to %s (up=%s down=%s): %s",
+                            self._party, dest_party, upstream_seq_id,
+                            downstream_seq_id, e,
+                        )
+                        out_ref.set_result(False)
+
+                cf.add_done_callback(_done)
+            except Exception as e:
+                logger.warning("[%s] failed to encode payload for %s: %s",
+                               self._party, dest_party, e)
+                out_ref.set_result(False)
+
+        if isinstance(data, LocalRef):
+            def _on_data(ref: LocalRef) -> None:
+                exc = ref.exception()
+                if exc is not None:
+                    logger.warning(
+                        "[%s] upstream task failed; cannot send to %s: %s",
+                        self._party, dest_party, exc,
+                    )
+                    out_ref.set_result(False)
+                    return
+                self._codec_pool.submit(_encode_and_send, ref.resolve())
+
+            data.add_done_callback(_on_data)
+        else:
+            self._codec_pool.submit(_encode_and_send, data)
+        return out_ref
+
+    # -- recv path (RecvProxy role) ------------------------------------------
+
+    def recv(
+        self,
+        src_party: str,
+        upstream_seq_id: Any,
+        downstream_seq_id: Any,
+    ) -> LocalRef:
+        """Park until the owner's push lands; resolves to the decoded value."""
+        out_ref = LocalRef()
+        allowed = self._cluster.serializing_allowed_list
+        device_put = self._job.device_put_received
+
+        cf = asyncio.run_coroutine_threadsafe(
+            self._mailbox.get(str(upstream_seq_id), str(downstream_seq_id)),
+            self._loop,
+        )
+
+        def _on_message(f) -> None:
+            try:
+                message: Message = f.result()
+            except Exception as e:
+                out_ref.set_exception(e)
+                return
+
+            def _decode():
+                try:
+                    value = wire.decode_payload(
+                        message.payload, allowed=allowed, device_put=device_put
+                    )
+                    out_ref.set_result(value)
+                except Exception as e:
+                    out_ref.set_exception(e)
+
+            self._codec_pool.submit(_decode)
+
+        cf.add_done_callback(_on_message)
+        return out_ref
+
+    # -- readiness ------------------------------------------------------------
+
+    def ping(self, dest_party: str, timeout_s: float = 1.0) -> bool:
+        cf = asyncio.run_coroutine_threadsafe(
+            self._get_client(dest_party).ping(timeout_s), self._loop
+        )
+        try:
+            return cf.result(timeout=timeout_s + 5)
+        except Exception:
+            return False
+
+    def get_stats(self) -> Dict[str, Any]:
+        stats = dict(self.stats)
+        stats.update(self._server.stats)
+        stats["pending_recvs"] = self._mailbox.pending_count()
+        return stats

@@ -1,0 +1,69 @@
+"""Either-side-first rendezvous mailbox.
+
+Reproduces the reference's event-dict race discipline
+(``barriers.py:61-90`` sender side vs ``:324-345`` receiver side): data may
+arrive before anyone asked for it, or a receiver may park before the data
+exists — whichever side arrives first creates the entry.  The reference
+mixes ``threading.Lock`` with asyncio inside a Ray actor (flagged as a
+wart at ``barriers.py:303``); here everything runs on a single asyncio
+loop, so no locks are needed at all.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+Key = Tuple[str, str]  # (upstream_seq_id, downstream_seq_id)
+
+
+@dataclasses.dataclass
+class Message:
+    src_party: str
+    upstream_seq_id: str
+    downstream_seq_id: str
+    payload: bytes
+    metadata: Dict[str, str]
+
+
+class _Entry:
+    __slots__ = ("event", "message")
+
+    def __init__(self) -> None:
+        self.event = asyncio.Event()
+        self.message: Optional[Message] = None
+
+
+class Mailbox:
+    """Keyed (upstream_seq_id, downstream_seq_id) → one message slot.
+
+    All methods must be called from the owning asyncio loop.
+    """
+
+    def __init__(self) -> None:
+        self._entries: Dict[Key, _Entry] = {}
+
+    def put(self, message: Message) -> None:
+        key = (message.upstream_seq_id, message.downstream_seq_id)
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = _Entry()
+            self._entries[key] = entry
+        entry.message = message
+        entry.event.set()
+
+    async def get(self, upstream_seq_id: str, downstream_seq_id: str) -> Message:
+        key = (str(upstream_seq_id), str(downstream_seq_id))
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = _Entry()
+            self._entries[key] = entry
+        await entry.event.wait()
+        # Pop: a rendezvous key is consumed exactly once (ref barriers.py:338-340).
+        self._entries.pop(key, None)
+        assert entry.message is not None
+        return entry.message
+
+    def pending_count(self) -> int:
+        return len(self._entries)

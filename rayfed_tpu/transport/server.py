@@ -1,0 +1,128 @@
+"""Asyncio transport server — the receive side of the push transport.
+
+Plays the role of the reference's ``RecverProxyActor`` gRPC server
+(``barriers.py:93-118, 280-351``) without an actor framework: one listener
+per party, frames demuxed into the rendezvous :class:`Mailbox`.  TLS
+(including mutual auth) is plain ``ssl`` on the listener.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import ssl
+from typing import Any, Callable, Dict, Optional
+
+from rayfed_tpu.transport import wire
+from rayfed_tpu.transport.rendezvous import Mailbox, Message
+
+logger = logging.getLogger(__name__)
+
+
+class TransportServer:
+    def __init__(
+        self,
+        party: str,
+        listen_addr: str,
+        mailbox: Mailbox,
+        max_message_size: int,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        on_message: Optional[Callable[[Message], None]] = None,
+    ) -> None:
+        self._party = party
+        host, _, port = listen_addr.rpartition(":")
+        self._host = host or "0.0.0.0"
+        self._port = int(port)
+        self._mailbox = mailbox
+        self._max_message_size = max_message_size
+        self._ssl_context = ssl_context
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._on_message = on_message
+        self.stats: Dict[str, Any] = {"receive_op_count": 0, "receive_bytes": 0}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection,
+            host=self._host,
+            port=self._port,
+            ssl=self._ssl_context,
+            limit=2**20,
+        )
+        logger.debug("[%s] transport server listening on %s:%s",
+                     self._party, self._host, self._port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    prefix = await reader.readexactly(wire.HEADER_SIZE)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                msg_type, _flags, hlen, plen = wire.unpack_frame_prefix(prefix)
+                header = json.loads(await reader.readexactly(hlen)) if hlen else {}
+                if plen > self._max_message_size:
+                    # Fatal (non-retryable): drain and drop the payload so the
+                    # sender's write never blocks on a full TCP buffer, then
+                    # echo rid so the client matches the pending send.
+                    remaining = plen
+                    while remaining:
+                        chunk = await reader.read(min(1 << 20, remaining))
+                        if not chunk:
+                            break
+                        remaining -= len(chunk)
+                    await self._reply(
+                        writer, wire.MSG_ERR,
+                        {"rid": header.get("rid"), "fatal": True,
+                         "error": f"message of {plen} bytes exceeds max "
+                                  f"{self._max_message_size}"},
+                    )
+                    break
+                payload = await reader.readexactly(plen) if plen else b""
+
+                if msg_type == wire.MSG_DATA:
+                    message = Message(
+                        src_party=header.get("src", "?"),
+                        upstream_seq_id=str(header.get("up")),
+                        downstream_seq_id=str(header.get("down")),
+                        payload=payload,
+                        metadata=header.get("meta", {}),
+                    )
+                    self.stats["receive_op_count"] += 1
+                    self.stats["receive_bytes"] += len(payload)
+                    if self._on_message is not None:
+                        self._on_message(message)
+                    self._mailbox.put(message)
+                    await self._reply(
+                        writer, wire.MSG_ACK, {"rid": header.get("rid"), "result": "OK"}
+                    )
+                elif msg_type == wire.MSG_PING:
+                    await self._reply(writer, wire.MSG_PONG, {"rid": header.get("rid")})
+                else:
+                    logger.warning("[%s] unexpected frame type %s from %s",
+                                   self._party, msg_type, peer)
+                    break
+        except Exception:  # pragma: no cover - connection-level robustness
+            logger.exception("[%s] connection handler error (peer=%s)",
+                             self._party, peer)
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _reply(self, writer: asyncio.StreamWriter, msg_type: int,
+                     header: Dict[str, Any]) -> None:
+        for buf in wire.pack_frame(msg_type, header):
+            writer.write(buf)
+        await writer.drain()

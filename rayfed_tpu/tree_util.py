@@ -1,0 +1,37 @@
+"""Pytree helpers built on ``jax.tree_util``.
+
+The reference vendors PyTorch's ``_pytree`` (``fed/tree_util.py:15``) to
+find FedObjects nested in containers.  On TPU the right substrate is JAX's
+own registry-backed C++ pytree, which already handles dict / list / tuple /
+namedtuple / OrderedDict and every user-registered JAX container, and is
+what the compute layer uses for params — one tree language everywhere.
+
+``FedObject`` and ``LocalRef`` are unregistered types, so they are leaves
+automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+
+def tree_flatten(
+    tree: Any, is_leaf: Optional[Callable[[Any], bool]] = None
+) -> Tuple[list, Any]:
+    """Flatten ``tree``; returns ``(leaves, treedef)``."""
+    return jax.tree_util.tree_flatten(tree, is_leaf=is_leaf)
+
+
+def tree_unflatten(leaves: list, treedef: Any) -> Any:
+    """Inverse of :func:`tree_flatten` (note: leaves first, like the reference)."""
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def tree_map(fn: Callable, tree: Any, *rest: Any, **kw) -> Any:
+    return jax.tree_util.tree_map(fn, tree, *rest, **kw)
+
+
+def tree_leaves(tree: Any, is_leaf: Optional[Callable[[Any], bool]] = None) -> list:
+    return jax.tree_util.tree_leaves(tree, is_leaf=is_leaf)

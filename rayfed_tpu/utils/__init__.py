@@ -1,0 +1,4 @@
+from rayfed_tpu.utils.validation import validate_address, validate_cluster_info
+from rayfed_tpu.utils.logging_utils import setup_logger
+
+__all__ = ["validate_address", "validate_cluster_info", "setup_logger"]

@@ -1,0 +1,23 @@
+"""Test config: force CPU JAX with an 8-device virtual mesh.
+
+The outer environment registers the real-TPU (axon) PJRT plugin from
+sitecustomize and pins ``jax_platforms`` via jax.config — plain env vars
+are ignored by then, so the override must also go through jax.config.
+Runs before the first backend initialization (pytest loads conftest before
+test modules).  Multi-party integration tests spawn fresh processes that
+apply the same overrides (see ``tests/multiproc.py``).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

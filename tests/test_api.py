@@ -1,0 +1,89 @@
+"""Single-party init/config/shutdown (ref tests/test_api.py:21-36)."""
+
+import rayfed_tpu as fed
+from rayfed_tpu.api import _get_cluster, _get_party, _get_tls
+from rayfed_tpu.runtime import get_runtime_or_none
+from tests.multiproc import make_cluster
+
+
+def test_init_and_shutdown():
+    cluster = make_cluster(["test_party"])
+    fed.init(address="local", cluster=cluster, party="test_party")
+    assert _get_party() == "test_party"
+    assert _get_cluster() == {
+        "test_party": cluster["test_party"]["address"]
+    }
+    assert _get_tls() is None
+    fed.shutdown()
+    assert get_runtime_or_none() is None
+
+
+def test_single_party_task_and_actor():
+    cluster = make_cluster(["solo"])
+    fed.init(address="local", cluster=cluster, party="solo")
+
+    @fed.remote
+    def double(x):
+        return 2 * x
+
+    @fed.remote
+    class Acc:
+        def __init__(self, v0):
+            self.v = v0
+
+        def add(self, d):
+            self.v += d
+            return self.v
+
+    o = double.party("solo").remote(21)
+    assert fed.get(o) == 42
+
+    acc = Acc.party("solo").remote(10)
+    r1 = acc.add.remote(5)
+    r2 = acc.add.remote(fed.get(r1))
+    assert fed.get(r2) == 30
+    fed.shutdown()
+
+
+def test_num_returns_local():
+    cluster = make_cluster(["solo"])
+    fed.init(address="local", cluster=cluster, party="solo")
+
+    @fed.remote
+    def pair():
+        return 1, 2
+
+    a, b = pair.party("solo").options(num_returns=2).remote()
+    assert fed.get(a) == 1 and fed.get(b) == 2
+    fed.shutdown()
+
+
+def test_seq_id_reset_on_reinit():
+    """Re-init must reproduce identical seq ids (ref test_reset_context.py)."""
+    cluster = make_cluster(["solo"])
+    fed.init(address="local", cluster=cluster, party="solo")
+
+    @fed.remote
+    def f():
+        return 0
+
+    o1 = f.party("solo").remote()
+    assert o1.get_fed_task_id() == "1#0"
+    fed.shutdown()
+
+    fed.init(address="local", cluster=make_cluster(["solo"]), party="solo")
+    o2 = f.party("solo").remote()
+    assert o2.get_fed_task_id() == "1#0"
+    fed.shutdown()
+
+
+def test_cleanup_thread_lifecycle():
+    """Watchdog thread is alive after init, gone after shutdown
+    (ref test_repeat_init.py:49-57)."""
+    for _ in range(3):
+        cluster = make_cluster(["solo"])
+        runtime = fed.init(address="local", cluster=cluster, party="solo")
+        assert runtime.cleanup_manager.check_thread_alive
+        cm = runtime.cleanup_manager
+        fed.shutdown()
+        assert not cm.check_thread_alive

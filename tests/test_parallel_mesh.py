@@ -1,0 +1,87 @@
+"""Mesh construction + sharding strategies on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rayfed_tpu.parallel import create_mesh
+from rayfed_tpu.parallel.sharding import (
+    ShardingStrategy,
+    data_parallel,
+    shard_params_by_rules,
+)
+
+
+def test_eight_cpu_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_create_mesh_shapes():
+    m = create_mesh({"dp": 2, "tp": 4})
+    assert dict(m.shape) == {"dp": 2, "tp": 4}
+    m2 = create_mesh({"dp": 2, "tp": -1})
+    assert dict(m2.shape) == {"dp": 2, "tp": 4}
+    m3 = create_mesh()
+    assert dict(m3.shape) == {"dp": 8}
+    with pytest.raises(ValueError):
+        create_mesh({"dp": 3})
+    with pytest.raises(ValueError):
+        create_mesh({"dp": -1, "tp": -1})
+
+
+def test_data_parallel_strategy():
+    mesh = create_mesh({"dp": 8})
+    strat = data_parallel(mesh)
+    batch = strat.shard_batch({"x": jnp.ones((16, 4)), "y": jnp.ones((16,))})
+    assert batch["x"].sharding.spec == P(("dp",), None)
+
+    params = strat.shard_params({"w": jnp.ones((4, 2)), "b": jnp.ones((2,))})
+
+    def step(params, batch):
+        logits = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean(logits)
+
+    out = strat.jit_step(step)(params, batch)
+    assert np.isfinite(float(out))
+
+
+def test_shard_params_by_rules():
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    params = {
+        "dense": {"kernel": jnp.ones((8, 16)), "bias": jnp.ones((16,))},
+        "emb": {"embedding": jnp.ones((32, 8))},
+    }
+    shardings = shard_params_by_rules(
+        mesh,
+        params,
+        rules=[
+            (r"dense/kernel", P(None, "tp")),
+            (r"embedding", P("tp", None)),
+        ],
+    )
+    assert shardings["dense"]["kernel"].spec == P(None, "tp")
+    assert shardings["dense"]["bias"].spec == P()
+    assert shardings["emb"]["embedding"].spec == P("tp", None)
+
+
+def test_rules_prune_missing_axes():
+    mesh = create_mesh({"dp": 8})  # no 'tp' axis
+    shardings = shard_params_by_rules(
+        mesh, {"k": jnp.ones((4, 4))}, rules=[(r"k", P(None, "tp"))]
+    )
+    assert shardings["k"].spec == P(None, None)
+
+
+def test_tp_matmul_produces_correct_result():
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    strat = ShardingStrategy(
+        mesh=mesh, batch_axes=("dp",), param_rules=((r"w", P(None, "tp")),)
+    )
+    w = strat.shard_params({"w": jnp.arange(32.0).reshape(4, 8)})
+    x = strat.shard_batch(jnp.ones((8, 4)))
+    out = strat.jit_step(lambda p, x: x @ p["w"])(w, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.ones((8, 4)) @ np.arange(32.0).reshape(4, 8)
+    )

@@ -1,0 +1,47 @@
+"""Pytree round-trips incl. leaf replacement (ref tests/without_ray_tests/test_tree_utils.py)."""
+
+from collections import OrderedDict, namedtuple
+
+import numpy as np
+
+from rayfed_tpu import tree_util
+from rayfed_tpu.fed_object import FedObject
+
+Point = namedtuple("Point", ["x", "y"])
+
+
+def test_flatten_unflatten_roundtrip():
+    tree = {
+        "a": [1, 2, (3, 4)],
+        "b": {"c": 5, "d": None},
+        "e": OrderedDict([("k", 6)]),
+        "p": Point(7, 8),
+    }
+    leaves, treedef = tree_util.tree_flatten(tree)
+    rebuilt = tree_util.tree_unflatten(leaves, treedef)
+    assert rebuilt == tree
+
+
+def test_leaf_replacement():
+    tree = ["hello", [1, 2], {"k": 3}]
+    leaves, treedef = tree_util.tree_flatten(tree)
+    replaced = [f"leaf-{i}" for i in range(len(leaves))]
+    rebuilt = tree_util.tree_unflatten(replaced, treedef)
+    assert rebuilt == ["leaf-0", ["leaf-1", "leaf-2"], {"k": "leaf-3"}]
+
+
+def test_fed_objects_are_leaves():
+    fo = FedObject("alice", 3, None)
+    tree = ["x", [fo], {"k": [fo, 1]}]
+    leaves, _ = tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, FedObject)
+    )
+    assert sum(1 for leaf in leaves if isinstance(leaf, FedObject)) == 2
+
+
+def test_arrays_are_leaves():
+    arr = np.ones((2, 2))
+    leaves, treedef = tree_util.tree_flatten({"w": arr, "b": [arr, arr]})
+    assert len(leaves) == 3
+    rebuilt = tree_util.tree_unflatten(leaves, treedef)
+    assert np.all(rebuilt["w"] == arr)

@@ -1,0 +1,33 @@
+"""Address validation matrix (ref tests/without_ray_tests/test_utils.py)."""
+
+import pytest
+
+from rayfed_tpu.utils import validate_address, validate_cluster_info
+
+
+def test_validate_address_accepts():
+    for addr in (None, "local", "127.0.0.1:8080", "example.com:11010"):
+        validate_address(addr)
+
+
+def test_validate_address_rejects():
+    for addr in ("nocolon", 123):
+        with pytest.raises(ValueError):
+            validate_address(addr)
+
+
+def test_validate_cluster_info():
+    validate_cluster_info({"alice": {"address": "127.0.0.1:11010"}})
+    validate_cluster_info(
+        {"alice": {"address": "127.0.0.1:11010", "listen_addr": "0.0.0.0:11010"}}
+    )
+    with pytest.raises(ValueError):
+        validate_cluster_info({})
+    with pytest.raises(ValueError):
+        validate_cluster_info({"alice": {}})
+    with pytest.raises(ValueError):
+        validate_cluster_info({"alice": {"address": "127.0.0.1"}})
+    with pytest.raises(ValueError):
+        validate_cluster_info({"alice": {"address": "127.0.0.1:notaport"}})
+    with pytest.raises(ValueError):
+        validate_cluster_info({"alice": {"address": "127.0.0.1:99999999"}})

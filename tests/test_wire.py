@@ -1,0 +1,96 @@
+"""Wire codec: zero-copy tensor payloads + allowlisted deserialization.
+
+Covers the capability of reference tests/serializations_tests/
+test_unpickle_with_whitelist.py plus the TPU-native array fast path.
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.transport import wire
+
+
+def _roundtrip(obj, **kw):
+    bufs = wire.encode_payload(obj)
+    payload = b"".join(bytes(b) for b in bufs)
+    return wire.decode_payload(payload, **kw)
+
+
+def test_scalars_and_containers():
+    obj = {"a": [1, 2.5, "s", None, True], "b": (3, {"c": 4})}
+    assert _roundtrip(obj) == obj
+
+
+def test_numpy_roundtrip():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = _roundtrip({"w": arr})
+    np.testing.assert_array_equal(out["w"], arr)
+    assert out["w"].dtype == np.float32
+
+
+def test_jax_array_roundtrip():
+    arr = jnp.arange(8, dtype=jnp.bfloat16).reshape(2, 4)
+    out = _roundtrip([arr])
+    np.testing.assert_array_equal(np.asarray(out[0], np.float32),
+                                  np.asarray(arr, np.float32))
+    assert out[0].dtype == jnp.bfloat16
+
+
+def test_jax_array_device_put():
+    arr = jnp.ones((4,))
+    out = _roundtrip(arr, device_put=True)
+    assert isinstance(out, jax.Array)
+
+
+def test_large_array_zero_copy_decode():
+    arr = np.random.default_rng(0).standard_normal((256, 256)).astype(np.float32)
+    out = _roundtrip(arr)
+    np.testing.assert_array_equal(out, arr)
+
+
+class CustomThing:
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return isinstance(other, CustomThing) and other.v == self.v
+
+
+def test_pickle_fallback_leaf():
+    obj = {"thing": CustomThing(7), "arr": np.ones(3)}
+    out = _roundtrip(obj)
+    assert out["thing"] == CustomThing(7)
+
+
+def test_allowlist_rejects_custom_class():
+    obj = {"thing": CustomThing(7)}
+    with pytest.raises(pickle.UnpicklingError):
+        _roundtrip(obj, allowed={"numpy": "*"})
+
+
+def test_allowlist_admits_numpy():
+    # numpy reconstruction goes through numpy internals; wildcard admits them.
+    obj = {"s": np.float64(1.5)}
+    out = _roundtrip(obj, allowed={"numpy": "*"})
+    assert out["s"] == np.float64(1.5)
+
+
+def test_allowlist_exact_names():
+    out = _roundtrip(
+        {"d": np.dtype("int32")}, allowed={"numpy": ["dtype"]}
+    )
+    assert out["d"] == np.dtype("int32")
+
+
+def test_frame_pack_unpack():
+    bufs = wire.pack_frame(wire.MSG_DATA, {"rid": 1, "up": "1#0"}, b"xyz")
+    blob = b"".join(bytes(b) for b in bufs)
+    msg_type, flags, hlen, plen = wire.unpack_frame_prefix(blob[: wire.HEADER_SIZE])
+    assert msg_type == wire.MSG_DATA
+    assert plen == 3
+    with pytest.raises(ValueError):
+        wire.unpack_frame_prefix(b"XXXX" + blob[4 : wire.HEADER_SIZE])
