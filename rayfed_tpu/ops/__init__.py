@@ -1,0 +1,24 @@
+"""TPU-native ops: attention family + pallas kernels.
+
+The reference (fengsp/rayfed) contains **no** attention or compute ops at
+all (SURVEY §5.7) — it is model-agnostic and delegates compute to user
+code inside Ray tasks.  For a TPU-first framework the compute layer is
+part of the framework: long-context sequence parallelism (ring attention,
+Ulysses all-to-all) and MXU-friendly kernels are first-class citizens
+consumed by the model family in :mod:`rayfed_tpu.models`.
+"""
+
+from rayfed_tpu.ops.attention import dot_product_attention, mha
+from rayfed_tpu.ops.flash_attention import flash_attention
+from rayfed_tpu.ops.ring_attention import ring_attention, make_ring_attention
+from rayfed_tpu.ops.ulysses import ulysses_attention, make_ulysses_attention
+
+__all__ = [
+    "dot_product_attention",
+    "mha",
+    "flash_attention",
+    "ring_attention",
+    "make_ring_attention",
+    "ulysses_attention",
+    "make_ulysses_attention",
+]

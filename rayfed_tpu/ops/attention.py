@@ -1,0 +1,151 @@
+"""Reference multi-head attention + the blockwise online-softmax core.
+
+All attention in the framework flows through two functions:
+
+- :func:`dot_product_attention` — the plain O(T²) reference used for
+  testing and short sequences; einsum-based so XLA maps it onto the MXU.
+- :func:`blockwise_accumulate` — one online-softmax accumulation step
+  over a K/V block.  Ring attention (``ring_attention.py``) uses it with
+  K/V blocks arriving over ``ppermute``; it is the same recurrence a
+  flash-attention kernel runs per tile (m/l/o running max, normalizer,
+  weighted sum — numerically identical to full softmax).
+
+Layout convention everywhere: ``[batch, seq, heads, head_dim]`` (BTHD).
+Accumulation is float32 regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() flushable
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    mask: Optional[jax.Array] = None,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> jax.Array:
+    """Plain softmax attention, BTHD layout.
+
+    ``q_offset``/``kv_offset`` are the global positions of the first query
+    / key token — used when q and k are shards of a longer sequence (the
+    causal mask must compare *global* positions).
+    """
+    orig_dtype = q.dtype
+    head_dim = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else head_dim**-0.5
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k.shape[1])
+        causal_mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(causal_mask[None, None, :, :], s, NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    # Guard fully-masked rows (can happen for causal shards where every
+    # key is in the future): softmax of all-NEG_INF must yield zeros.
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.max(s, axis=-1, keepdims=True) <= NEG_INF / 2, 0.0, p)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(orig_dtype)
+
+
+def mha(
+    x: jax.Array,
+    wq: jax.Array,
+    wk: jax.Array,
+    wv: jax.Array,
+    wo: jax.Array,
+    *,
+    num_heads: int,
+    causal: bool = False,
+    attn_fn=None,
+) -> jax.Array:
+    """Full MHA block: project, attend, merge.  ``x``: [B, T, D_model].
+
+    ``wq/wk/wv``: [D_model, H*Dh]; ``wo``: [H*Dh, D_model].  ``attn_fn``
+    lets callers swap in ring/Ulysses/pallas attention (same signature as
+    :func:`dot_product_attention`).
+    """
+    b, t, d_model = x.shape
+    attn_fn = attn_fn or dot_product_attention
+    q = (x @ wq).reshape(b, t, num_heads, -1)
+    k = (x @ wk).reshape(b, t, num_heads, -1)
+    v = (x @ wv).reshape(b, t, num_heads, -1)
+    o = attn_fn(q, k, v, causal=causal)
+    return o.reshape(b, t, -1) @ wo
+
+
+def blockwise_accumulate(
+    q: jax.Array,
+    k_blk: jax.Array,
+    v_blk: jax.Array,
+    o_acc: jax.Array,
+    m_acc: jax.Array,
+    l_acc: jax.Array,
+    *,
+    scale: float,
+    q_offset,
+    kv_offset,
+    causal: bool,
+    block_valid=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax step over a K/V block (the flash recurrence).
+
+    State: ``o_acc`` [B,Tq,H,D] un-normalized output, ``m_acc``/``l_acc``
+    [B,H,Tq] running row-max / normalizer, all float32.  ``q_offset`` /
+    ``kv_offset`` may be traced scalars (ring step index × block length).
+    ``block_valid`` (traced bool) zeroes the whole block's contribution —
+    used by ring attention to skip fully-future blocks under causality
+    without data-dependent control flow.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k_blk.astype(jnp.float32)
+    )
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k_blk.shape[1])
+        causal_mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(causal_mask[None, None, :, :], s, NEG_INF)
+    if block_valid is not None:
+        s = jnp.where(block_valid, s, NEG_INF)
+
+    m_blk = jnp.max(s, axis=-1)  # [B,H,Tq]
+    m_new = jnp.maximum(m_acc, m_blk)
+    # exp(NEG_INF - NEG_INF) would be 1 on fully-masked rows; clamp the
+    # shift so masked rows contribute exp(NEG_INF - 0) == 0 instead.
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])  # [B,H,Tq,Tk]
+    correction = jnp.exp(jnp.where(m_acc <= NEG_INF / 2, NEG_INF, m_acc) - m_safe)
+    l_new = l_acc * correction + jnp.sum(p, axis=-1)
+    o_blk = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+    o_new = o_acc * correction.transpose(0, 2, 1)[..., None] + o_blk
+    return o_new, m_new, l_new
+
+
+def blockwise_finalize(o_acc: jax.Array, l_acc: jax.Array, dtype) -> jax.Array:
+    """Normalize the accumulated output; fully-masked rows become zeros."""
+    l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    out = o_acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(dtype)
+
+
+def init_blockwise_state(
+    q: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, tq, h, d = q.shape
+    o = jnp.zeros((b, tq, h, d), jnp.float32)
+    m = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+    return o, m, l

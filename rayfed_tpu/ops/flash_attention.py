@@ -1,0 +1,291 @@
+"""Pallas TPU flash attention (tiled online-softmax) with custom VJP.
+
+The MXU wants big tiles streamed through VMEM; materializing the [T, T]
+score matrix in HBM wastes the bandwidth that is the usual bottleneck.
+This kernel keeps one q tile resident in VMEM and streams k/v tiles
+through it, carrying the online-softmax state (running max m, normalizer
+l, un-normalized accumulator) in VMEM scratch across the innermost grid
+dimension — TPU grids execute sequentially, so scratch persists across
+the kv loop.  Matches `rayfed_tpu.ops.attention.dot_product_attention`
+numerically (same recurrence as ``blockwise_accumulate``).
+
+Backward is a memory-efficient blockwise recompute in plain JAX (scan
+over kv blocks, O(T·block) live memory) using the saved per-row
+log-sum-exp — the standard flash-attention backward formulation.
+
+Runs in interpret mode off-TPU (auto-detected), so the CPU test mesh
+exercises the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable off-TPU; kernels then run interpreted
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _flash_fwd_kernel(
+    q_ref,  # (1, block_q, d)
+    k_ref,  # (1, block_k, d)
+    v_ref,  # (1, block_k, d)
+    o_ref,  # (1, block_q, d)
+    lse_ref,  # (1, block_q)
+    acc_ref,  # VMEM (block_q, d) f32
+    m_ref,  # VMEM (block_q, 128) f32
+    l_ref,  # VMEM (block_q, 128) f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Under causality a kv block strictly after the last query row of this
+    # q block contributes nothing — skip its matmuls entirely.
+    should_compute = True
+    if causal:
+        should_compute = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
+        p = jnp.exp(s - m_cur)
+        correction = jnp.exp(m_prev - m_cur)
+        l_cur = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * correction + pv
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l_final = l_ref[:, :1]
+        l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-37))).astype(
+            lse_ref.dtype
+        )
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    """Run the pallas kernel on [BH, T, D] inputs; returns (o, lse)."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    if t_q % block_q or t_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({t_q}, {t_k}) must divide block sizes "
+            f"({block_q}, {block_k})"
+        )
+    grid = (bh, t_q // block_q, t_k // block_k)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_backward_blockwise(
+    q, k, v, o, lse, do, *, scale: float, causal: bool, block_k: int
+):
+    """Blockwise flash backward in plain JAX ([BH, T, D] layout, f32).
+
+    Standard formulation: with P = exp(S - lse) and D = rowsum(dO ∘ O),
+    dV = Pᵀ dO, dS = P ∘ (dO Vᵀ − D), dQ = dS K·scale, dK = dSᵀ Q·scale.
+    Scans over kv blocks so only one [T_q, block_k] score tile is live.
+    """
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_k = min(block_k, t_k)
+    num_blocks = t_k // block_k
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32).reshape(bh, num_blocks, block_k, d)
+    vf = v.astype(jnp.float32).reshape(bh, num_blocks, block_k, d)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (bh, t_q)
+    q_pos = jnp.arange(t_q)
+
+    def body(dq_acc, blk):
+        k_blk, v_blk, j = blk  # (bh, block_k, d), index
+        s = jnp.einsum("bqd,bkd->bqk", qf * scale, k_blk)
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # (bh, t_q, block_k)
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_blk) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    dq, (dk, dv) = jax.lax.scan(
+        body,
+        dq0,
+        (kf.transpose(1, 0, 2, 3), vf.transpose(1, 0, 2, 3), jnp.arange(num_blocks)),
+    )
+    dk = dk.transpose(1, 0, 2, 3).reshape(bh, t_k, d)
+    dv = dv.transpose(1, 0, 2, 3).reshape(bh, t_k, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_bthd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_bthd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _bthd_to_bht(x):  # [B,T,H,D] -> [B*H, T, D]
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _bht_to_bthd(x, b, h):  # [B*H, T, D] -> [B,T,H,D]
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_bthd(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    o, lse = _flash_forward(
+        _bthd_to_bht(q),
+        _bthd_to_bht(k),
+        _bthd_to_bht(v),
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    out = _bht_to_bthd(o, b, h)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_bthd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    dq, dk, dv = _flash_backward_blockwise(
+        _bthd_to_bht(q),
+        _bthd_to_bht(k),
+        _bthd_to_bht(v),
+        _bthd_to_bht(out),
+        lse,
+        _bthd_to_bht(g),
+        scale=scale,
+        causal=causal,
+        block_k=block_k,
+    )
+    return _bht_to_bthd(dq, b, h), _bht_to_bthd(dk, b, h), _bht_to_bthd(dv, b, h)
+
+
+_flash_bthd.defvjp(_flash_fwd_bthd, _flash_bwd_bthd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    **_unused,
+) -> jax.Array:
+    """Tiled flash attention, BTHD layout — drop-in for
+    :func:`rayfed_tpu.ops.attention.dot_product_attention` (also as the
+    ``attn_fn`` of Ulysses attention).
+
+    ``interpret=None`` auto-selects the pallas interpreter off-TPU so the
+    same code path runs on the CPU test mesh.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    return _flash_bthd(q, k, v, scale, causal, block_q, block_k, interpret)

@@ -1,0 +1,112 @@
+"""Ring attention: sequence parallelism over the ``sp`` mesh axis.
+
+Long-context attention where the sequence is sharded across devices and
+K/V shards rotate around the ring via ``lax.ppermute`` while each device
+accumulates its queries' attention with the online-softmax recurrence
+(:func:`rayfed_tpu.ops.attention.blockwise_accumulate`).  Per step the
+ppermute overlaps ICI transfer of the *next* K/V block with compute on the
+current one — XLA schedules the collective-permute asynchronously, which
+is the whole point of the ring formulation (Liu et al., Ring Attention
+with Blockwise Transformers, 2023).
+
+Absent from the reference by design (SURVEY §5.7: "no ring attention,
+context parallel, blockwise, or Ulysses anywhere") — here it is a
+party-local sharding strategy of the compute layer.
+
+Two entry points:
+
+- :func:`ring_attention` — collective form, call *inside* ``shard_map``
+  with sequence-sharded [B, T_local, H, D] blocks.
+- :func:`make_ring_attention` — wraps it in ``shard_map`` over a mesh
+  axis; takes/returns global [B, T, H, D] arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rayfed_tpu.ops.attention import (
+    blockwise_accumulate,
+    blockwise_finalize,
+    init_blockwise_state,
+)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Collective ring attention over ``axis_name`` (call inside shard_map).
+
+    ``q``/``k``/``v``: this device's sequence shard, [B, T_local, H, D].
+    Shard *i* holds global positions ``[i*T_local, (i+1)*T_local)``.
+    Returns the attention output for the local queries, same shape/dtype.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    # Rotate kv "forward" (device d hands its block to d+1), so at step i
+    # device d holds the kv block originally owned by (d - i) mod n.
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    q_offset = my_idx * t_local
+
+    def body(carry, step):
+        o, m, l, k_cur, v_cur = carry
+        src = jnp.mod(my_idx - step, axis_size)
+        o, m, l = blockwise_accumulate(
+            q,
+            k_cur,
+            v_cur,
+            o,
+            m,
+            l,
+            scale=scale,
+            q_offset=q_offset,
+            kv_offset=src * t_local,
+            causal=causal,
+        )
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_cur, v_cur), None
+
+    state = init_blockwise_state(q) + (k, v)
+    (o, _m, l, _k, _v), _ = lax.scan(body, state, jnp.arange(axis_size))
+    return blockwise_finalize(o, l, q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    seq_axis: str = "sp",
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+):
+    """Build a global-view ring attention fn sharded over ``mesh[seq_axis]``.
+
+    Returned fn maps [B, T, H, D] → [B, T, H, D] with T sharded over
+    ``seq_axis`` (T must divide evenly).  Batch stays replicated here;
+    compose with dp by vmapping/sharding outside.
+    """
+    spec = P(None, seq_axis, None, None)
+    fn = functools.partial(
+        ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )

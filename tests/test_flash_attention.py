@@ -1,0 +1,72 @@
+"""Pallas flash attention vs dense reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.ops import dot_product_attention
+from rayfed_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(key, b=2, t=64, h=2, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d), dtype),
+        jax.random.normal(kk, (b, t, h, d), dtype),
+        jax.random.normal(kv, (b, t, h, d), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    expected = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, expected, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_single_block():
+    q, k, v = _qkv(jax.random.PRNGKey(1), t=32)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    expected = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, expected, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(2), t=32, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=8, block_k=8) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_bf16():
+    q, k, v = _qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    expected = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        out.astype(np.float32), expected.astype(np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_flash_jit_and_shape_check():
+    q, k, v = _qkv(jax.random.PRNGKey(4), t=48)
+    jitted = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16)
+    )
+    out = jitted(q, k, v)
+    assert out.shape == q.shape
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k, v, block_q=13, block_k=16)
