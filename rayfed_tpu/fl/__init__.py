@@ -1,0 +1,17 @@
+"""Federated-learning algorithms built on the fed API.
+
+The reference provides the *engine* (party-pinned tasks + push transport)
+and leaves FL algorithms to users; its own canonical workload is the
+FedAvg-style mean-aggregation loop in ``tests/test_fed_get.py:47-82``.
+Here the common algorithms ship with the framework:
+
+- :mod:`fedavg` — horizontal FL: weighted parameter averaging across
+  parties, plus an actor template for local training.
+- :mod:`split` — vertical/split FL: forward activations pushed one way,
+  gradients pushed back (BASELINE.md config #5).
+"""
+
+from rayfed_tpu.fl.fedavg import aggregate, tree_average, tree_weighted_sum
+from rayfed_tpu.fl.split import SplitTrainer
+
+__all__ = ["aggregate", "tree_average", "tree_weighted_sum", "SplitTrainer"]

@@ -1,0 +1,128 @@
+"""Split / vertical federated learning: activations forward, grads back.
+
+BASELINE.md config #5: encoder@alice → head@bob.  Per step:
+
+1. encoder party runs its half, *pushes* activations to the head party
+   (owner-initiated, per the framework's push perimeter);
+2. head party computes loss + gradient w.r.t. activations, updates its
+   head params, pushes the activation gradient back;
+3. encoder party closes its saved VJP and updates encoder params.
+
+Both halves keep params on their own devices between steps (actor
+state); only [B, D] activations and their gradients cross the silo
+boundary each step — this is the "activation push GB/s" path the
+benchmark measures.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class _EncoderActor:
+    """Party-local encoder half: forward + deferred backward via VJP."""
+
+    def __init__(self, params: Any, apply_fn: Callable, lr: float):
+        self._params = params
+        self._apply = apply_fn
+        self._lr = lr
+        self._vjp = None
+
+    def forward(self, x):
+        out, vjp = jax.vjp(lambda p: self._apply(p, x), self._params)
+        self._vjp = vjp
+        return out
+
+    def backward(self, g):
+        if self._vjp is None:
+            raise RuntimeError("backward called before forward")
+        (grads,) = self._vjp(g)
+        self._params = jax.tree_util.tree_map(
+            lambda p, gr: p - self._lr * gr, self._params, grads
+        )
+        self._vjp = None
+        return True
+
+    def get_params(self):
+        return self._params
+
+
+class _HeadActor:
+    """Party-local head half: loss + grads for both head and activations."""
+
+    def __init__(self, params: Any, apply_fn: Callable, loss_fn: Callable, lr: float):
+        self._params = params
+        self._apply = apply_fn
+        self._loss = loss_fn
+        self._lr = lr
+
+        def _step(params, h, y):
+            def f(params, h):
+                return self._loss(self._apply(params, h), y)
+
+            loss, (g_params, g_h) = jax.value_and_grad(f, argnums=(0, 1))(params, h)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, g_params
+            )
+            return new_params, g_h, loss
+
+        self._step = jax.jit(_step)
+
+    def step(self, h, y):
+        self._params, g_h, loss = self._step(self._params, h, y)
+        return g_h, loss
+
+    def get_params(self):
+        return self._params
+
+
+class SplitTrainer:
+    """Wire a split model across two parties over the fed API.
+
+    Call from the shared (multi-controller) program *after* ``fed.init``.
+    ``encoder_apply(params, x) -> activations``;
+    ``head_apply(params, h) -> logits``; ``loss_fn(logits, y) -> scalar``.
+    """
+
+    def __init__(
+        self,
+        *,
+        encoder_party: str,
+        head_party: str,
+        encoder_params: Any,
+        encoder_apply: Callable,
+        head_params: Any,
+        head_apply: Callable,
+        loss_fn: Callable,
+        lr: float = 0.1,
+    ):
+        import rayfed_tpu as fed
+
+        self._fed = fed
+        self._encoder = (
+            fed.remote(_EncoderActor)
+            .party(encoder_party)
+            .remote(encoder_params, encoder_apply, lr)
+        )
+        self._head = (
+            fed.remote(_HeadActor)
+            .party(head_party)
+            .remote(head_params, head_apply, loss_fn, lr)
+        )
+
+    def step(self, x_obj, y_obj):
+        """One split step; ``x_obj`` owned by encoder party, ``y_obj`` by
+        head party.  Returns the loss as a FedObject owned by the head
+        party (``fed.get`` it on any party)."""
+        h = self._encoder.forward.remote(x_obj)
+        g_h, loss = self._head.step.options(num_returns=2).remote(h, y_obj)
+        self._encoder.backward.remote(g_h)
+        return loss
+
+    def encoder_params(self):
+        return self._encoder.get_params.remote()
+
+    def head_params(self):
+        return self._head.get_params.remote()

@@ -1,0 +1,197 @@
+"""BERT-style transformer encoder — the split/vertical-FL workload.
+
+Covers BASELINE.md config #5 (encoder@alice → head@bob: alice runs the
+encoder and pushes pooled activations across the silo boundary; bob runs
+the classification head and pushes gradients back).  The module is
+therefore explicitly split-friendly: :func:`apply_encoder` and
+:func:`apply_head` are separate functions over separate param subtrees
+(``split_params``), either side jit-compiles its half independently.
+
+Post-LN BERT with learned positions; attention is pluggable (dense /
+pallas flash / ring / Ulysses via ``attn_fn``).  TP partition rules shard
+attention heads and the FFN intermediate over ``tp``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rayfed_tpu.ops.attention import dot_product_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    intermediate_size: int = 1024
+    max_position: int = 512
+    num_classes: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(
+        hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072, **kw
+    )
+
+
+def _dense_init(key, d_in, d_out, scale=0.02):
+    return jax.random.normal(key, (d_in, d_out)) * scale
+
+
+def _ln_params(d):
+    return {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}
+
+
+def init_bert(key: jax.Array, config: BertConfig) -> Params:
+    d, f = config.hidden_size, config.intermediate_size
+    keys = iter(jax.random.split(key, 4 + 8 * config.num_layers))
+    params: Params = {
+        "embeddings": {
+            "word": _dense_init(next(keys), config.vocab_size, d),
+            "position": _dense_init(next(keys), config.max_position, d),
+            "ln": _ln_params(d),
+        }
+    }
+    for i in range(config.num_layers):
+        params[f"layer{i}"] = {
+            "attn": {
+                "wq": _dense_init(next(keys), d, d),
+                "wk": _dense_init(next(keys), d, d),
+                "wv": _dense_init(next(keys), d, d),
+                "wo": _dense_init(next(keys), d, d),
+                "bq": jnp.zeros((d,)),
+                "bk": jnp.zeros((d,)),
+                "bv": jnp.zeros((d,)),
+                "bo": jnp.zeros((d,)),
+            },
+            "ln1": _ln_params(d),
+            "mlp": {
+                "wi": _dense_init(next(keys), d, f),
+                "bi": jnp.zeros((f,)),
+                "wo": _dense_init(next(keys), f, d),
+                "bo": jnp.zeros((d,)),
+            },
+            "ln2": _ln_params(d),
+        }
+    params["pooler"] = {
+        "kernel": _dense_init(next(keys), d, d),
+        "bias": jnp.zeros((d,)),
+    }
+    params["head"] = {
+        "kernel": _dense_init(next(keys), d, config.num_classes),
+        "bias": jnp.zeros((config.num_classes,)),
+    }
+    return params
+
+
+def _layer_norm(x, p, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def apply_encoder(
+    params: Params,
+    input_ids: jax.Array,
+    config: BertConfig,
+    *,
+    attention_mask: Optional[jax.Array] = None,
+    attn_fn: Callable = dot_product_attention,
+) -> jax.Array:
+    """Encoder: [B, T] token ids → [B, T, D] contextual embeddings."""
+    b, t = input_ids.shape
+    d = config.hidden_size
+    h = config.num_heads
+    emb = params["embeddings"]
+    x = emb["word"].astype(config.dtype)[input_ids]
+    x = x + emb["position"].astype(config.dtype)[None, :t, :]
+    x = _layer_norm(x, emb["ln"], config.layer_norm_eps)
+
+    mask = None
+    if attention_mask is not None:
+        mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,T]
+
+    for i in range(config.num_layers):
+        layer = params[f"layer{i}"]
+        a = layer["attn"]
+
+        def proj(w, bias):
+            return (x @ w.astype(x.dtype) + bias.astype(x.dtype)).reshape(b, t, h, -1)
+
+        q, k, v = proj(a["wq"], a["bq"]), proj(a["wk"], a["bk"]), proj(a["wv"], a["bv"])
+        if mask is not None:
+            attn = attn_fn(q, k, v, mask=mask)
+        else:
+            attn = attn_fn(q, k, v)
+        attn = attn.reshape(b, t, d) @ a["wo"].astype(x.dtype) + a["bo"].astype(x.dtype)
+        x = _layer_norm(x + attn, layer["ln1"], config.layer_norm_eps)
+
+        m = layer["mlp"]
+        y = jax.nn.gelu(x @ m["wi"].astype(x.dtype) + m["bi"].astype(x.dtype))
+        y = y @ m["wo"].astype(x.dtype) + m["bo"].astype(x.dtype)
+        x = _layer_norm(x + y, layer["ln2"], config.layer_norm_eps)
+    return x
+
+
+def apply_pooler(params: Params, hidden: jax.Array) -> jax.Array:
+    """[B, T, D] → [B, D]: tanh-projected [CLS] (position 0) embedding."""
+    p = params["pooler"]
+    return jnp.tanh(hidden[:, 0, :] @ p["kernel"].astype(hidden.dtype) + p["bias"])
+
+
+def apply_head(params: Params, pooled: jax.Array) -> jax.Array:
+    """Classification head over pooled activations: [B, D] → [B, C]."""
+    p = params["head"]
+    return (pooled @ p["kernel"].astype(pooled.dtype) + p["bias"]).astype(jnp.float32)
+
+
+def apply_bert(
+    params: Params,
+    input_ids: jax.Array,
+    config: BertConfig,
+    *,
+    attention_mask: Optional[jax.Array] = None,
+    attn_fn: Callable = dot_product_attention,
+) -> jax.Array:
+    """Full model: ids → logits (encoder + pooler + head in one party)."""
+    hidden = apply_encoder(
+        params, input_ids, config, attention_mask=attention_mask, attn_fn=attn_fn
+    )
+    return apply_head(params, apply_pooler(params, hidden))
+
+
+def split_params(params: Params) -> Tuple[Params, Params]:
+    """Partition params for split FL: (encoder side, head side).
+
+    Encoder side keeps embeddings + layers + pooler (alice); head side is
+    the classifier (bob).  Keys are disjoint so FedAvg/optimizers can run
+    per side.
+    """
+    encoder = {k: v for k, v in params.items() if k != "head"}
+    head = {"head": params["head"]}
+    return encoder, head
+
+
+# TP rules: attention projections shard heads (output dim) over tp; FFN
+# in over tp, out back over None; embeddings shard vocab over fsdp.
+PARTITION_RULES = (
+    (r"attn/w[qkv]", P(None, "tp")),
+    (r"attn/wo", P("tp", None)),
+    (r"mlp/wi", P(None, "tp")),
+    (r"mlp/wo", P("tp", None)),
+    (r"embeddings/word", P("fsdp", None)),
+    (r"pooler/kernel|head/kernel", P(None, None)),
+)

@@ -1,0 +1,281 @@
+"""Llama-3-style decoder (RMSNorm, RoPE, GQA, SwiGLU) — the LLM workload.
+
+Covers BASELINE.md config #4 (cross-silo Llama LoRA federated
+fine-tune).  TPU-first design decisions:
+
+- **Stacked layer params + ``lax.scan``**: all layers live in one pytree
+  with a leading layer dim, the forward scans over it — one compiled
+  layer body regardless of depth (fast compiles, natural pipeline
+  stages), optionally rematerialized (``remat=True``) to trade FLOPs for
+  HBM.
+- **Pluggable attention**: dense, pallas flash, ring (sp axis) or
+  Ulysses drop in via ``attn_fn`` — long-context sequence parallelism is
+  a constructor argument, not a model rewrite.
+- **bfloat16 activations** with float32 RMSNorm/softmax/logits.
+- **LoRA as a low-rank bypass** (``x@A@B`` added to ``x@W``), never
+  materializing ``W + AB`` — see :mod:`rayfed_tpu.models.lora`.
+
+TP/FSDP partition rules shard attention heads and FFN width over ``tp``
+and the remaining big dims over ``fsdp``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rayfed_tpu.ops.attention import dot_product_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    intermediate_size: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """Test-scale config (runs on the CPU mesh in seconds)."""
+    defaults = dict(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=128,
+        max_seq_len=128,
+        dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def init_llama(key: jax.Array, config: LlamaConfig) -> Params:
+    d = config.hidden_size
+    dh = config.head_dim
+    h, kv = config.num_heads, config.num_kv_heads
+    f = config.intermediate_size
+    L = config.num_layers
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, *shape, fan_in):
+        return (jax.random.normal(key, shape) * fan_in**-0.5).astype(jnp.float32)
+
+    lk = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": dense(k_embed, config.vocab_size, d, fan_in=1.0) * 0.02 * d**0.5,
+        "layers": {
+            "attn_norm": jnp.ones((L, d)),
+            "wq": dense(lk[0], L, d, h * dh, fan_in=d),
+            "wk": dense(lk[1], L, d, kv * dh, fan_in=d),
+            "wv": dense(lk[2], L, d, kv * dh, fan_in=d),
+            "wo": dense(lk[3], L, h * dh, d, fan_in=h * dh),
+            "mlp_norm": jnp.ones((L, d)),
+            "w_gate": dense(lk[4], L, d, f, fan_in=d),
+            "w_up": dense(lk[5], L, d, f, fan_in=d),
+            "w_down": dense(lk[6], L, f, d, fan_in=f),
+        },
+        "final_norm": jnp.ones((d,)),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(k_head, d, config.vocab_size, fan_in=d)
+    return params
+
+
+def _rms_norm(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables [T, head_dim/2] for the given absolute positions."""
+    freqs = 1.0 / theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (x[..., ::2], x[..., 1::2]); x: [B, T, H, Dh]."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+
+
+def _linear(x, w, lora_entry, dtype):
+    """x @ w with an optional LoRA low-rank bypass (x@A)@B · scale."""
+    out = x @ w.astype(dtype)
+    if lora_entry is not None:
+        a = lora_entry["a"].astype(dtype)
+        b = lora_entry["b"].astype(dtype)
+        scale = jax.lax.stop_gradient(lora_entry["scale"]).astype(dtype)
+        out = out + (x @ a) @ b * scale
+    return out
+
+
+def apply_llama(
+    params: Params,
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    *,
+    lora: Optional[Params] = None,
+    attn_fn: Callable = dot_product_attention,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Forward: [B, T] ids → [B, T, V] float32 logits (causal LM)."""
+    b, t = input_ids.shape
+    dtype = config.dtype
+    h, kv, dh = config.num_heads, config.num_kv_heads, config.head_dim
+
+    x = params["embed"].astype(dtype)[input_ids]
+    if positions is None:
+        positions = jnp.arange(t)
+    cos, sin = rope_tables(positions, dh, config.rope_theta)
+
+    lora_layers = (lora or {}).get("layers")
+    # Scan xs need a leading layer dim on every leaf — hoist the scalar
+    # LoRA scales out of the scanned tree into the closure.
+    lora_scales = {}
+    if lora_layers is not None:
+        lora_scales = {k: v["scale"] for k, v in lora_layers.items()}
+        lora_layers = {
+            k: {"a": v["a"], "b": v["b"]} for k, v in lora_layers.items()
+        }
+
+    def layer_body(x, scanned):
+        lp = scanned["w"]
+        ll = scanned.get("lora")
+
+        def lget(name):
+            if ll is None or name not in ll:
+                return None
+            return {**ll[name], "scale": lora_scales[name]}
+
+        y = _rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = _linear(y, lp["wq"], lget("wq"), dtype).reshape(b, t, h, dh)
+        k = _linear(y, lp["wk"], lget("wk"), dtype).reshape(b, t, kv, dh)
+        v = _linear(y, lp["wv"], lget("wv"), dtype).reshape(b, t, kv, dh)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if kv != h:  # GQA: repeat kv heads to match query heads
+            reps = h // kv
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+        attn = attn_fn(q, k, v, causal=True)
+        x = x + _linear(attn.reshape(b, t, h * dh), lp["wo"], lget("wo"), dtype)
+
+        y = _rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        gate = jax.nn.silu(_linear(y, lp["w_gate"], lget("w_gate"), dtype))
+        up = _linear(y, lp["w_up"], lget("w_up"), dtype)
+        x = x + _linear(gate * up, lp["w_down"], lget("w_down"), dtype)
+        return x, None
+
+    if config.remat:
+        layer_body = jax.checkpoint(layer_body)
+
+    scanned = {"w": params["layers"]}
+    if lora_layers is not None:
+        scanned["lora"] = lora_layers
+    x, _ = jax.lax.scan(layer_body, x, scanned)
+
+    x = _rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return logits
+
+
+def lm_loss(logits: jax.Array, targets: jax.Array, mask=None) -> jax.Array:
+    """Next-token cross entropy; ``targets``[i] is the label for pos i."""
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+# Partition rules (stacked layout: dim 0 is the layer axis — never shard).
+PARTITION_RULES = (
+    (r"layers/w[qkv]$", P(None, "fsdp", "tp")),
+    (r"layers/wo$", P(None, "tp", "fsdp")),
+    (r"layers/w_(gate|up)$", P(None, "fsdp", "tp")),
+    (r"layers/w_down$", P(None, "tp", "fsdp")),
+    (r"^embed$", P("tp", "fsdp")),
+    (r"^lm_head$", P("fsdp", "tp")),
+)
+
+
+def make_lora_train_step(
+    config: LlamaConfig,
+    lr: float = 1e-4,
+    *,
+    attn_fn: Callable = dot_product_attention,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+):
+    """Adam train step over **LoRA params only** (base weights frozen).
+
+    Signature: (lora, opt, base_params, ids) → (lora, opt, loss); the
+    next-token targets are ``ids`` shifted left.  ``opt`` = (step, m, v)
+    from :func:`init_adam`.
+    """
+
+    def loss_fn(lora, base_params, ids):
+        logits = apply_llama(base_params, ids, config, lora=lora, attn_fn=attn_fn)
+        return lm_loss(logits[:, :-1], ids[:, 1:])
+
+    def step_fn(lora, opt, base_params, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(lora, base_params, ids)
+        count, m, v = opt
+        count = count + 1
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
+        v = jax.tree_util.tree_map(
+            lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, grads
+        )
+        mhat_scale = 1.0 / (1 - b1**count)
+        vhat_scale = 1.0 / (1 - b2**count)
+        lora = jax.tree_util.tree_map(
+            lambda p, m_, v_: p
+            - lr * (m_ * mhat_scale) / (jnp.sqrt(v_ * vhat_scale) + eps),
+            lora,
+            m,
+            v,
+        )
+        return lora, (count, m, v), loss
+
+    return jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+def init_adam(params: Params):
+    zeros = functools.partial(jax.tree_util.tree_map, jnp.zeros_like)
+    return (jnp.zeros((), jnp.int32), zeros(params), zeros(params))

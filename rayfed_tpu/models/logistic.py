@@ -1,0 +1,75 @@
+"""Logistic regression + small MLP — the horizontal-FL baseline models.
+
+Covers BASELINE.md config #2 (2-party FedAvg on MNIST logistic
+regression).  Kept deliberately simple: params are flat dicts, the train
+step is one fused jit (forward + backward + SGD update), and the batch
+dim shards over ``dp`` so the same code runs 1-device or across a mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_logistic(key: jax.Array, num_features: int, num_classes: int) -> Params:
+    return {
+        "w": jnp.zeros((num_features, num_classes), jnp.float32),
+        "b": jnp.zeros((num_classes,), jnp.float32),
+    }
+
+
+def apply_logistic(params: Params, x: jax.Array) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+def init_mlp(
+    key: jax.Array, num_features: int, hidden: Tuple[int, ...], num_classes: int
+) -> Params:
+    dims = (num_features,) + tuple(hidden) + (num_classes,)
+    params: Params = {}
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        params[f"layer{i}"] = {
+            "kernel": jax.random.normal(sub, (d_in, d_out)) * (2.0 / d_in) ** 0.5,
+            "bias": jnp.zeros((d_out,)),
+        }
+    return params
+
+
+def apply_mlp(params: Params, x: jax.Array) -> jax.Array:
+    n = len(params)
+    for i in range(n):
+        layer = params[f"layer{i}"]
+        x = x @ layer["kernel"] + layer["bias"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean cross-entropy; ``labels`` are int class ids."""
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def make_train_step(apply_fn, lr: float = 0.1):
+    """Fused SGD train step: (params, x, y) -> (params, loss)."""
+
+    def loss_fn(params, x, y):
+        return softmax_cross_entropy(apply_fn(params, x), y)
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,114 @@
+"""LoRA adapters over arbitrary param pytrees.
+
+Low-Rank Adaptation for the federated fine-tune workload (BASELINE.md
+config #4): each party trains only the small A/B factors; FedAvg
+aggregates adapters (kilobytes over DCN instead of the full model).
+
+Generic over any pytree: ``init_lora`` matches leaves by path regex and
+creates factors over the *last two* dims, treating leading dims (e.g. the
+stacked layer axis of :mod:`rayfed_tpu.models.llama`) as batch.  The
+compute path never materializes ``W + AB`` — consumers add the low-rank
+bypass ``(x@A)@B·scale`` (see ``llama._linear``), which is both faster
+and keeps the frozen weights donate-able.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Sequence[str] = (r"w[qv]$",)  # regexes over '/'-joined paths
+    init_scale: float = 0.01
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:  # pragma: no cover
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def init_lora(key: jax.Array, params: Params, config: LoraConfig) -> Params:
+    """Build a LoRA tree mirroring the subtrees of matched ≥2-D leaves.
+
+    Returned tree has the same *container* structure as ``params`` but
+    only matched leaves, each replaced by ``{"a", "b", "scale"}``.
+    A is gaussian-init, B zero-init (adapter starts as identity).
+    """
+    compiled = [re.compile(pat) for pat in config.targets]
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    out: Params = {}
+    for path, leaf in leaves:
+        path_s = _path_str(path)
+        if leaf.ndim < 2 or not any(c.search(path_s) for c in compiled):
+            continue
+        key, sub = jax.random.split(key)
+        lead = leaf.shape[:-2]
+        d_in, d_out = leaf.shape[-2], leaf.shape[-1]
+        entry = {
+            "a": jax.random.normal(sub, (*lead, d_in, config.rank), jnp.float32)
+            * config.init_scale,
+            "b": jnp.zeros((*lead, config.rank, d_out), jnp.float32),
+            "scale": jnp.asarray(config.scaling, jnp.float32),
+        }
+        # Insert at the same nested position.
+        node = out
+        keys = path_s.split("/")
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = entry
+    return out
+
+
+def lora_delta(entry: Params) -> jax.Array:
+    """Materialized AB·scale delta (for merging only, not the hot path)."""
+    return (
+        jnp.einsum("...ir,...ro->...io", entry["a"], entry["b"]) * entry["scale"]
+    )
+
+
+def merge_lora(params: Params, lora: Params) -> Params:
+    """Fold adapters into the base weights: W ← W + AB·scale."""
+
+    def _merge(base_node, lora_node):
+        if isinstance(lora_node, dict) and set(lora_node) == {"a", "b", "scale"}:
+            return (base_node + lora_delta(lora_node)).astype(base_node.dtype)
+        if isinstance(lora_node, dict):
+            return {
+                k: _merge(base_node[k], lora_node[k]) if k in lora_node else base_node[k]
+                for k in base_node
+            }
+        return base_node
+
+    return _merge(params, lora)
+
+
+def num_lora_params(lora: Params) -> int:
+    sizes = [
+        x.size
+        for path, x in jax.tree_util.tree_leaves_with_path(lora)
+        if not _path_str(path).endswith("scale")
+    ]
+    return int(sum(sizes))

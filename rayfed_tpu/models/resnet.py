@@ -1,0 +1,220 @@
+"""ResNet (v1.5) in functional JAX — the cross-silo CV workload.
+
+Covers BASELINE.md config #3 (4-party FedAvg ResNet-18 / CIFAR-10).
+NHWC layout (TPU-native for convolutions), batch-norm running statistics
+carried in an explicit ``state`` pytree (functionally pure — FedAvg can
+average params and states alike), and a CIFAR-style stem option (3×3
+conv, no max-pool) for 32×32 inputs.
+
+Under ``jit`` with the batch sharded over ``dp``, the batch-norm
+reductions are *global* means in the SPMD program — XLA inserts the
+cross-device psums automatically, so multi-device BN is sync-BN for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)  # ResNet-18
+    num_classes: int = 10
+    width: int = 64
+    small_inputs: bool = True  # CIFAR stem: 3x3/1 conv, no maxpool
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+
+def resnet18(num_classes: int = 10, **kw) -> "ResNetConfig":
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes: int = 10, **kw) -> "ResNetConfig":
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+def _conv_init(key, kh, kw, c_in, c_out):
+    fan_in = kh * kw * c_in
+    return jax.random.normal(key, (kh, kw, c_in, c_out)) * (2.0 / fan_in) ** 0.5
+
+
+def _bn_params(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+
+def init_resnet(
+    key: jax.Array, config: ResNetConfig, input_channels: int = 3
+) -> Tuple[Params, State]:
+    params: Params = {}
+    state: State = {}
+    keys = iter(jax.random.split(key, 4 + 2 * sum(config.stage_sizes) * 3))
+
+    stem_k = 3 if config.small_inputs else 7
+    params["stem"] = {
+        "conv": _conv_init(next(keys), stem_k, stem_k, input_channels, config.width),
+        "bn": _bn_params(config.width),
+    }
+    state["stem"] = _bn_state(config.width)
+
+    c_in = config.width
+    for stage, num_blocks in enumerate(config.stage_sizes):
+        c_out = config.width * (2**stage)
+        for block in range(num_blocks):
+            name = f"stage{stage}_block{block}"
+            stride = 2 if (block == 0 and stage > 0) else 1
+            bp: Params = {
+                "conv1": _conv_init(next(keys), 3, 3, c_in, c_out),
+                "bn1": _bn_params(c_out),
+                "conv2": _conv_init(next(keys), 3, 3, c_out, c_out),
+                "bn2": _bn_params(c_out),
+            }
+            bs: State = {"bn1": _bn_state(c_out), "bn2": _bn_state(c_out)}
+            if stride != 1 or c_in != c_out:
+                bp["proj"] = _conv_init(next(keys), 1, 1, c_in, c_out)
+                bp["proj_bn"] = _bn_params(c_out)
+                bs["proj_bn"] = _bn_state(c_out)
+            params[name] = bp
+            state[name] = bs
+            c_in = c_out
+
+    params["head"] = {
+        "kernel": jnp.zeros((c_in, config.num_classes)),
+        "bias": jnp.zeros((config.num_classes,)),
+    }
+    return params, state
+
+
+def _conv(x, kernel, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(x, p, s, *, train: bool, momentum: float, eps: float):
+    if train:
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.var(x, axis=(0, 1, 2))
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + eps) * p["scale"]
+    out = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + p["bias"].astype(x.dtype)
+    return out, new_s
+
+
+def apply_resnet(
+    params: Params,
+    state: State,
+    x: jax.Array,
+    config: ResNetConfig,
+    *,
+    train: bool = False,
+) -> Tuple[jax.Array, State]:
+    """Forward pass: NHWC images → logits.  Returns updated BN state."""
+    new_state: State = {}
+    x = x.astype(config.dtype)
+
+    stem_stride = 1 if config.small_inputs else 2
+    x = _conv(x, params["stem"]["conv"], stride=stem_stride)
+    x, new_state["stem"] = _batch_norm(
+        x,
+        params["stem"]["bn"],
+        state["stem"],
+        train=train,
+        momentum=config.bn_momentum,
+        eps=config.bn_eps,
+    )
+    x = jax.nn.relu(x)
+    if not config.small_inputs:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+
+    for stage, num_blocks in enumerate(config.stage_sizes):
+        for block in range(num_blocks):
+            name = f"stage{stage}_block{block}"
+            bp, bs = params[name], state[name]
+            nbs: State = {}
+            stride = 2 if (block == 0 and stage > 0) else 1
+
+            residual = x
+            y = _conv(x, bp["conv1"], stride=stride)
+            y, nbs["bn1"] = _batch_norm(
+                y, bp["bn1"], bs["bn1"], train=train,
+                momentum=config.bn_momentum, eps=config.bn_eps,
+            )
+            y = jax.nn.relu(y)
+            y = _conv(y, bp["conv2"])
+            y, nbs["bn2"] = _batch_norm(
+                y, bp["bn2"], bs["bn2"], train=train,
+                momentum=config.bn_momentum, eps=config.bn_eps,
+            )
+            if "proj" in bp:
+                residual = _conv(x, bp["proj"], stride=stride)
+                residual, nbs["proj_bn"] = _batch_norm(
+                    residual, bp["proj_bn"], bs["proj_bn"], train=train,
+                    momentum=config.bn_momentum, eps=config.bn_eps,
+                )
+            x = jax.nn.relu(y + residual)
+            new_state[name] = nbs
+
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["kernel"].astype(x.dtype) + params["head"]["bias"]
+    return logits.astype(jnp.float32), new_state
+
+
+# FSDP/TP partitioning rules for shard_params_by_rules: conv kernels shard
+# output channels (last dim) over fsdp; the head over tp if present.
+PARTITION_RULES = (
+    (r"conv|proj$", P(None, None, None, "fsdp")),
+    (r"head/kernel", P(None, ("fsdp", "tp"))),
+)
+
+
+def make_train_step(config: ResNetConfig, lr: float = 0.1, momentum: float = 0.9):
+    """SGD-with-momentum train step: (params, state, opt, x, y) → (...)."""
+    from rayfed_tpu.models.logistic import softmax_cross_entropy
+
+    def loss_fn(params, state, x, y):
+        logits, new_state = apply_resnet(params, state, x, config, train=True)
+        return softmax_cross_entropy(logits, y), new_state
+
+    def step(params, state, opt, x, y):
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, x, y
+        )
+        new_opt = jax.tree_util.tree_map(
+            lambda m, g: momentum * m + g, opt, grads
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_opt
+        )
+        return new_params, new_state, new_opt, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def init_opt_state(params: Params) -> Params:
+    return jax.tree_util.tree_map(jnp.zeros_like, params)

@@ -99,16 +99,14 @@ def blockwise_accumulate(
     q_offset,
     kv_offset,
     causal: bool,
-    block_valid=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One online-softmax step over a K/V block (the flash recurrence).
 
     State: ``o_acc`` [B,Tq,H,D] un-normalized output, ``m_acc``/``l_acc``
     [B,H,Tq] running row-max / normalizer, all float32.  ``q_offset`` /
-    ``kv_offset`` may be traced scalars (ring step index × block length).
-    ``block_valid`` (traced bool) zeroes the whole block's contribution —
-    used by ring attention to skip fully-future blocks under causality
-    without data-dependent control flow.
+    ``kv_offset`` may be traced scalars (ring step index × block length);
+    the global-position causal mask also handles fully-future blocks
+    (every element masked → zero contribution via the m/l guards below).
     """
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k_blk.astype(jnp.float32)
@@ -118,8 +116,6 @@ def blockwise_accumulate(
         k_pos = kv_offset + jnp.arange(k_blk.shape[1])
         causal_mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(causal_mask[None, None, :, :], s, NEG_INF)
-    if block_valid is not None:
-        s = jnp.where(block_valid, s, NEG_INF)
 
     m_blk = jnp.max(s, axis=-1)  # [B,H,Tq]
     m_new = jnp.maximum(m_acc, m_blk)

@@ -46,7 +46,7 @@ def _flash_fwd_kernel(
     k_ref,  # (1, block_k, d)
     v_ref,  # (1, block_k, d)
     o_ref,  # (1, block_q, d)
-    lse_ref,  # (1, block_q)
+    lse_ref,  # (1, block_q, 128) — lane-broadcast so the block is tileable
     acc_ref,  # VMEM (block_q, d) f32
     m_ref,  # VMEM (block_q, 128) f32
     l_ref,  # VMEM (block_q, 128) f32
@@ -55,6 +55,8 @@ def _flash_fwd_kernel(
     causal: bool,
     block_q: int,
     block_k: int,
+    q_offset: int,
+    kv_offset: int,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -67,10 +69,14 @@ def _flash_fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # Under causality a kv block strictly after the last query row of this
-    # q block contributes nothing — skip its matmuls entirely.
+    # q block contributes nothing — skip its matmuls entirely.  Offsets
+    # are static (compile-time) global positions of the first q/kv token.
     should_compute = True
     if causal:
-        should_compute = ki * block_k <= qi * block_q + block_q - 1
+        should_compute = (
+            kv_offset + ki * block_k
+            <= q_offset + qi * block_q + block_q - 1
+        )
 
     @pl.when(should_compute)
     def _compute():
@@ -81,10 +87,10 @@ def _flash_fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            k_pos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -107,9 +113,9 @@ def _flash_fwd_kernel(
         l_final = l_ref[:, :1]
         l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-37))).astype(
-            lse_ref.dtype
-        )
+        lse_ref[0] = (
+            m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-37))
+        ).astype(lse_ref.dtype)
 
 
 def _flash_forward(
@@ -121,18 +127,38 @@ def _flash_forward(
     causal: bool,
     block_q: int,
     block_k: int,
+    q_offset: int,
+    kv_offset: int,
     interpret: bool,
 ):
-    """Run the pallas kernel on [BH, T, D] inputs; returns (o, lse)."""
+    """Run the pallas kernel on [BH, T, D] inputs; returns (o, lse).
+
+    On the compiled TPU path the head dim is zero-padded to a multiple of
+    128 (MXU lane width) — zeros in the contracting dim don't change
+    q·kᵀ, and padded v columns produce padded output columns we slice
+    off.  The lse output is lane-broadcast to (bh, t_q, 128) so its block
+    satisfies the TPU (8, 128) tiling rule, then lane 0 is taken.
+    """
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
     if t_q % block_q or t_k % block_k:
         raise ValueError(
-            f"sequence lengths ({t_q}, {t_k}) must divide block sizes "
+            f"block sizes ({block_q}, {block_k}) must divide the "
+            f"sequence lengths ({t_q}, {t_k})"
+        )
+    if not interpret and (block_q % 8 or block_k % 8):
+        raise ValueError(
+            f"TPU tiling requires block sizes divisible by 8, got "
             f"({block_q}, {block_k})"
         )
+    d_pad = d if interpret else ((d + 127) // 128) * 128
+    if d_pad != d:
+        pad = [(0, 0), (0, 0), (0, d_pad - d)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
     grid = (bh, t_q // block_q, t_k // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel,
@@ -140,35 +166,41 @@ def _flash_forward(
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        q_offset=q_offset,
+        kv_offset=kv_offset,
     )
     scratch = [
-        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, d_pad), jnp.float32),
         pltpu.VMEM((block_q, 128), jnp.float32),
         pltpu.VMEM((block_q, 128), jnp.float32),
     ]
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_q, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, 128), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
+    if d_pad != d:
+        o = o[..., :d]
+    return o, lse[..., 0]
 
 
 def _flash_backward_blockwise(
-    q, k, v, o, lse, do, *, scale: float, causal: bool, block_k: int
+    q, k, v, o, lse, do, *, scale: float, causal: bool, block_k: int,
+    q_offset: int = 0, kv_offset: int = 0,
 ):
     """Blockwise flash backward in plain JAX ([BH, T, D] layout, f32).
 
@@ -185,13 +217,13 @@ def _flash_backward_blockwise(
     vf = v.astype(jnp.float32).reshape(bh, num_blocks, block_k, d)
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (bh, t_q)
-    q_pos = jnp.arange(t_q)
+    q_pos = q_offset + jnp.arange(t_q)
 
     def body(dq_acc, blk):
         k_blk, v_blk, j = blk  # (bh, block_k, d), index
         s = jnp.einsum("bqd,bkd->bqk", qf * scale, k_blk)
         if causal:
-            k_pos = j * block_k + jnp.arange(block_k)
+            k_pos = kv_offset + j * block_k + jnp.arange(block_k)
             s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s, NEG_INF)
         p = jnp.exp(s - lse[..., None])  # (bh, t_q, block_k)
         dv = jnp.einsum("bqk,bqd->bkd", p, dof)
@@ -213,10 +245,14 @@ def _flash_backward_blockwise(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
-def _flash_bthd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd_bthd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_bthd(
+    q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret
+):
+    out, _ = _flash_fwd_bthd(
+        q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret
+    )
     return out
 
 
@@ -230,7 +266,9 @@ def _bht_to_bthd(x, b, h):  # [B*H, T, D] -> [B,T,H,D]
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd_bthd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_bthd(
+    q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret
+):
     b, t, h, d = q.shape
     o, lse = _flash_forward(
         _bthd_to_bht(q),
@@ -240,13 +278,17 @@ def _flash_fwd_bthd(q, k, v, scale, causal, block_q, block_k, interpret):
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        q_offset=q_offset,
+        kv_offset=kv_offset,
         interpret=interpret,
     )
     out = _bht_to_bthd(o, b, h)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_bthd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd_bthd(
+    scale, causal, block_q, block_k, q_offset, kv_offset, interpret, res, g
+):
     q, k, v, out, lse = res
     b, t, h, d = q.shape
     dq, dk, dv = _flash_backward_blockwise(
@@ -259,6 +301,8 @@ def _flash_bwd_bthd(scale, causal, block_q, block_k, interpret, res, g):
         scale=scale,
         causal=causal,
         block_k=block_k,
+        q_offset=q_offset,
+        kv_offset=kv_offset,
     )
     return _bht_to_bthd(dq, b, h), _bht_to_bthd(dk, b, h), _bht_to_bthd(dv, b, h)
 
@@ -275,17 +319,30 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    mask: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
-    **_unused,
 ) -> jax.Array:
     """Tiled flash attention, BTHD layout — drop-in for
     :func:`rayfed_tpu.ops.attention.dot_product_attention` (also as the
     ``attn_fn`` of Ulysses attention).
 
+    ``q_offset``/``kv_offset`` are *static* global positions of the first
+    q/kv token (sharded-causal use).  Arbitrary dense ``mask`` is not
+    supported by the tiled kernel — use ``dot_product_attention``.
     ``interpret=None`` auto-selects the pallas interpreter off-TPU so the
     same code path runs on the CPU test mesh.
     """
+    if mask is not None:
+        raise ValueError(
+            "flash_attention does not support a dense mask; use "
+            "dot_product_attention (or causal=True with offsets)"
+        )
     if interpret is None:
         interpret = not _on_tpu()
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    return _flash_bthd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return _flash_bthd(
+        q, k, v, scale, causal, block_q, block_k,
+        int(q_offset), int(kv_offset), interpret,
+    )

@@ -133,7 +133,10 @@ def encode_payload(obj: Any) -> List:
     for leaf in leaves:
         if isinstance(leaf, jax.Array):
             host = np.asarray(jax.device_get(leaf))
-            host = np.ascontiguousarray(host)
+            if not host.flags["C_CONTIGUOUS"]:
+                # NB: np.ascontiguousarray promotes 0-d to (1,) — only
+                # call it when actually needed (0-d is always contiguous).
+                host = np.ascontiguousarray(host)
             manifest_leaves.append(
                 {
                     "k": "nd",
@@ -145,7 +148,7 @@ def encode_payload(obj: Any) -> List:
             )
             buffers.append(_array_buffer(host))
         elif isinstance(leaf, np.ndarray):
-            host = np.ascontiguousarray(leaf)
+            host = leaf if leaf.flags["C_CONTIGUOUS"] else np.ascontiguousarray(leaf)
             if host.dtype == object:
                 blob = serialization.dumps(host)
                 manifest_leaves.append({"k": "pkl", "n": len(blob)})

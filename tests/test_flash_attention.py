@@ -70,3 +70,44 @@ def test_flash_jit_and_shape_check():
     assert out.shape == q.shape
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, k, v, block_q=13, block_k=16)
+
+
+def test_flash_offsets_match_dense():
+    q, k, v = _qkv(jax.random.PRNGKey(5), t=16)
+    out = flash_attention(
+        q, k, v, causal=True, q_offset=16, kv_offset=0, block_q=8, block_k=8
+    )
+    expected = dot_product_attention(q, k, v, causal=True, q_offset=16, kv_offset=0)
+    np.testing.assert_allclose(out, expected, atol=1e-5, rtol=1e-5)
+    # Fully-future kv block: all rows masked -> zeros, not NaN.
+    out2 = flash_attention(
+        q, k, v, causal=True, q_offset=0, kv_offset=100, block_q=8, block_k=8
+    )
+    np.testing.assert_allclose(out2, np.zeros_like(out2))
+
+
+def test_flash_rejects_dense_mask():
+    q, k, v = _qkv(jax.random.PRNGKey(6), t=16)
+    with pytest.raises(ValueError, match="mask"):
+        flash_attention(q, k, v, mask=jnp.ones((1, 1, 16, 16), bool))
+
+
+def test_flash_offset_gradients():
+    q, k, v = _qkv(jax.random.PRNGKey(7), t=16, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, q_offset=16, block_q=8, block_k=8
+            ) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, causal=True, q_offset=16) ** 2
+        )
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)

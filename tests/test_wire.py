@@ -94,3 +94,20 @@ def test_frame_pack_unpack():
     assert plen == 3
     with pytest.raises(ValueError):
         wire.unpack_frame_prefix(b"XXXX" + blob[4 : wire.HEADER_SIZE])
+
+
+def test_scalar_and_noncontiguous_arrays_roundtrip():
+    """0-d arrays must stay 0-d (np.ascontiguousarray promotes to (1,));
+    non-contiguous views must be copied, not corrupted."""
+    import jax.numpy as jnp
+
+    cases = [
+        jnp.float32(3.5),
+        np.array(5.0),
+        jnp.ones((3, 2))[::-1],
+        np.arange(12).reshape(3, 4).T,
+    ]
+    for x in cases:
+        out = _roundtrip(x)
+        assert out.shape == x.shape, (x.shape, out.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
