@@ -1,0 +1,224 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline: 2-party FedAvg on MNIST-shaped logistic regression
+(BASELINE.md config #2), run as two real processes with the real push
+transport between them, sharing the locally visible accelerator.
+
+The reference (fengsp/rayfed) publishes no benchmark numbers
+(SURVEY §6), so ``vs_baseline`` is measured against the recorded
+first-round value of this framework itself when available
+(``BENCH_r*.json`` written by the driver), else 1.0.
+
+Usage: ``python bench.py`` (give the first run a few minutes for
+compiles).  Extra configs: ``python bench.py --all`` also benchmarks the
+split-FL activation-push path and prints one JSON line per config (the
+headline line is printed last).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CLUSTER = {
+    "alice": {"address": "127.0.0.1:13010"},
+    "bob": {"address": "127.0.0.1:13011"},
+}
+
+N, D, CLASSES = 1024, 784, 10
+LOCAL_STEPS = 4
+WARMUP_ROUNDS = 3
+MEASURE_ROUNDS = 20
+
+
+def _run_fedavg_party(party: str, result_q) -> None:
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import aggregate
+    from rayfed_tpu.models import logistic
+
+    logging.disable(logging.WARNING)
+    fed.init(address="local", cluster=CLUSTER, party=party)
+
+    @fed.remote
+    class Trainer:
+        def __init__(self, seed: int):
+            key = jax.random.PRNGKey(seed)
+            self._x = jax.random.normal(key, (N, D))
+            w = jax.random.normal(jax.random.PRNGKey(0), (D, CLASSES))
+            self._y = jnp.argmax(self._x @ w, axis=-1)
+            self._step = logistic.make_train_step(logistic.apply_logistic, lr=0.2)
+
+        def train(self, params):
+            for _ in range(LOCAL_STEPS):
+                params, _loss = self._step(params, self._x, self._y)
+            jax.block_until_ready(params["w"])
+            return params
+
+    alice = Trainer.party("alice").remote(1)
+    bob = Trainer.party("bob").remote(2)
+
+    params = logistic.init_logistic(jax.random.PRNGKey(0), D, CLASSES)
+
+    def do_round(params):
+        return aggregate([alice.train.remote(params), bob.train.remote(params)])
+
+    for _ in range(WARMUP_ROUNDS):
+        params = do_round(params)
+    jax.block_until_ready(params["w"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_ROUNDS):
+        params = do_round(params)
+    jax.block_until_ready(params["w"])
+    elapsed = time.perf_counter() - t0
+
+    if result_q is not None:
+        result_q.put((party, MEASURE_ROUNDS / elapsed))
+    fed.shutdown()
+
+
+def _run_split_party(party: str, result_q) -> None:
+    """Split-FL activation-push throughput (config #5 shape)."""
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import SplitTrainer
+    from rayfed_tpu.models.logistic import softmax_cross_entropy
+
+    logging.disable(logging.WARNING)
+    fed.init(address="local", cluster=CLUSTER, party=party)
+
+    n, d_in, d_hidden, classes = 2048, 256, 768, 10
+
+    @fed.remote
+    def load_x():
+        return jax.random.normal(jax.random.PRNGKey(7), (n, d_in))
+
+    @fed.remote
+    def load_y():
+        return jax.random.randint(jax.random.PRNGKey(8), (n,), 0, classes)
+
+    def encoder_apply(params, x):
+        return jnp.tanh(x @ params["k"])
+
+    def head_apply(params, h):
+        return h @ params["k"]
+
+    trainer = SplitTrainer(
+        encoder_party="alice",
+        head_party="bob",
+        encoder_params={
+            "k": jax.random.normal(jax.random.PRNGKey(0), (d_in, d_hidden)) * 0.05
+        },
+        encoder_apply=encoder_apply,
+        head_params={
+            "k": jax.random.normal(jax.random.PRNGKey(1), (d_hidden, classes)) * 0.05
+        },
+        head_apply=head_apply,
+        loss_fn=softmax_cross_entropy,
+        lr=0.1,
+    )
+    x_obj = load_x.party("alice").remote()
+    y_obj = load_y.party("bob").remote()
+
+    steps = 12
+    fed.get(trainer.step(x_obj, y_obj))  # warmup
+    fed.get(trainer.step(x_obj, y_obj))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x_obj, y_obj)
+    fed.get(loss)
+    elapsed = time.perf_counter() - t0
+    # Per step: activations alice->bob + grads bob->alice, f32.
+    bytes_per_step = 2 * n * d_hidden * 4
+    if result_q is not None:
+        result_q.put((party, steps * bytes_per_step / elapsed / 1e9))
+    fed.shutdown()
+
+
+def _two_party(target) -> float:
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(p, q)) for p in ("alice", "bob")]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + 600
+    while len(results) < 2 and time.time() < deadline:
+        try:
+            party, value = q.get(timeout=5)
+            results[party] = value
+        except Exception:
+            if any(p.exitcode not in (None, 0) for p in procs):
+                break
+    for p in procs:
+        p.join(30)
+        if p.is_alive():
+            p.terminate()
+    if len(results) < 2:
+        raise RuntimeError(f"benchmark failed; partial results: {results}")
+    return sum(results.values()) / len(results)
+
+
+def _prior_baseline(metric: str):
+    values = []
+    for path in sorted(glob.glob(os.path.join(os.path.dirname(__file__), "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get("metric") == metric and rec.get("value"):
+                values.append(float(rec["value"]))
+        except Exception:
+            continue
+    return values[0] if values else None
+
+
+def main() -> None:
+    run_all = "--all" in sys.argv
+
+    if run_all:
+        gbps = _two_party(_run_split_party)
+        print(
+            json.dumps(
+                {
+                    "metric": "split_fl_activation_push_GBps",
+                    "value": round(gbps, 3),
+                    "unit": "GB/s",
+                    "vs_baseline": 1.0,
+                }
+            ),
+            flush=True,
+        )
+
+    metric = "fedavg_mnist_2party_rounds_per_sec"
+    rps = _two_party(_run_fedavg_party)
+    prior = _prior_baseline(metric)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(rps, 3),
+                "unit": "rounds/s",
+                "vs_baseline": round(rps / prior, 3) if prior else 1.0,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -98,8 +98,14 @@ def _flash_fwd_kernel(
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.maximum(jnp.max(s, axis=1, keepdims=True), m_prev)
-        p = jnp.exp(s - m_cur)
-        correction = jnp.exp(m_prev - m_cur)
+        # Fully-masked rows keep m_cur == NEG_INF; clamp the shift so
+        # their p = exp(NEG_INF - 0) == 0 instead of exp(0) == 1 (same
+        # guard as attention.blockwise_accumulate).
+        m_safe = jnp.where(m_cur <= NEG_INF / 2, 0.0, m_cur)
+        p = jnp.exp(s - m_safe)
+        correction = jnp.exp(
+            jnp.where(m_prev <= NEG_INF / 2, NEG_INF, m_prev) - m_safe
+        )
         l_cur = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -225,7 +231,11 @@ def _flash_backward_blockwise(
         if causal:
             k_pos = kv_offset + j * block_k + jnp.arange(block_k)
             s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # (bh, t_q, block_k)
+        # Masked entries must contribute 0 — for fully-masked rows lse is
+        # ~NEG_INF too, and exp(s - lse) would be exp(0) = 1.
+        p = jnp.where(
+            s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[..., None])
+        )  # (bh, t_q, block_k)
         dv = jnp.einsum("bqk,bqd->bkd", p, dof)
         dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk)
         ds = p * (dp - delta[..., None])

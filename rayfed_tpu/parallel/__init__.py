@@ -17,11 +17,15 @@ from rayfed_tpu.parallel.mesh import (
     AXIS_TP,
     create_mesh,
 )
+from rayfed_tpu.parallel.pipeline import make_pipeline, pipeline_collective, stack_params
 from rayfed_tpu.parallel.sharding import ShardingStrategy
 
 __all__ = [
     "create_mesh",
     "ShardingStrategy",
+    "make_pipeline",
+    "pipeline_collective",
+    "stack_params",
     "AXIS_DP",
     "AXIS_FSDP",
     "AXIS_TP",

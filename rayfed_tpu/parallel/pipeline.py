@@ -1,0 +1,126 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe-style).
+
+Equal-width pipelining the TPU way: stages are shards of a *stacked*
+layer pytree over ``pp``; activations hop stage→stage with
+``lax.ppermute`` inside a ``lax.scan`` over ticks — no host round trips,
+no per-stage processes.  XLA overlaps the collective-permute with the
+next tick's compute, so the only inherent cost is the (S−1)-tick bubble,
+amortized by the number of microbatches.
+
+Absent from the reference (SURVEY §2.10: no PP anywhere); here it is a
+party-local sharding strategy: combine ``pp`` with ``dp``/``tp`` axes in
+one mesh and the stage body is itself free to use tp/sp collectives.
+
+Constraints (the classic equal-width contract):
+
+- stage input and output shapes/dtypes are identical;
+- every leaf of the stacked params has leading dim == number of stages ×
+  layers-per-stage (the stage receives its slice with that leading dim).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_collective(
+    stage_params: Any,
+    x_microbatches: jax.Array,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Collective form — call inside ``shard_map``.
+
+    ``stage_params``: this stage's slice of the stacked params (leading
+    dim = layers per stage).  ``x_microbatches``: [M, mb, ...] replicated
+    across stages (only stage 0 reads it).  Returns [M, mb, ...]
+    outputs, replicated across stages.
+    """
+    num_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    num_mb = x_microbatches.shape[0]
+    total_ticks = num_mb + num_stages - 1
+    perm = [(k, (k + 1) % num_stages) for k in range(num_stages)]
+
+    state = jnp.zeros_like(x_microbatches[0])
+    outputs = jnp.zeros_like(x_microbatches)
+
+    def tick(carry, i):
+        state, outputs = carry
+        # Stage s processes microbatch (i - s) on tick i, if in range.
+        mb_idx = jnp.clip(i, 0, num_mb - 1)
+        x_in = jnp.where(stage == 0, x_microbatches[mb_idx], state)
+        y = stage_fn(stage_params, x_in)
+        # Last stage banks its finished microbatch j = i - (S-1).
+        j = i - (num_stages - 1)
+        banked = outputs.at[jnp.clip(j, 0, num_mb - 1)].set(y)
+        outputs = jnp.where((stage == num_stages - 1) & (j >= 0), banked, outputs)
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(total_ticks)
+    )
+    # Replicate the last stage's banked outputs to every stage.
+    return lax.psum(
+        jnp.where(stage == num_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+
+
+def make_pipeline(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    axis_name: str = "pp",
+    num_microbatches: int,
+):
+    """Build a pipelined apply: (stacked_params, x) → y.
+
+    ``stacked_params``: pytree whose leaves have leading dim =
+    total layers (divisible by the ``pp`` axis size); sharded over
+    ``axis_name`` on dim 0.  ``x``: [B, ...] with B divisible by
+    ``num_microbatches``; returns [B, ...].
+    """
+    n_stages = mesh.shape[axis_name]
+
+    collective = functools.partial(
+        pipeline_collective, stage_fn=stage_fn, axis_name=axis_name
+    )
+    sharded = jax.shard_map(
+        collective,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def apply(stacked_params, x):
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] % n_stages:
+                raise ValueError(
+                    f"stacked param leading dim {leaf.shape[0]} not divisible "
+                    f"by {n_stages} pipeline stages"
+                )
+        b = x.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch {b} not divisible by {num_microbatches} microbatches"
+            )
+        mbs = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+        out = sharded(stacked_params, mbs)
+        return out.reshape(b, *out.shape[2:])
+
+    return apply
+
+
+def stack_params(params_list) -> Any:
+    """Stack per-layer param pytrees into one stacked tree (dim 0 = layer)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)

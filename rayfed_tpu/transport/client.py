@@ -43,7 +43,9 @@ class TransportClient:
         metadata: Optional[Dict[str, str]] = None,
         ssl_context: Optional[ssl.SSLContext] = None,
         server_hostname: Optional[str] = None,
+        checksum: bool = True,
     ) -> None:
+        self._checksum = checksum
         self._src_party = src_party
         self._dest_party = dest_party
         host, _, port = address.rpartition(":")
@@ -150,6 +152,14 @@ class TransportClient:
                     self._writer.write(buf)
                 await self._writer.drain()
             return await asyncio.wait_for(fut, timeout=self._timeout_s)
+        except SendError:
+            # App-level MSG_ERR reply for THIS request (e.g. checksum
+            # mismatch, oversize).  The connection itself is healthy —
+            # don't tear it down or fail the other pipelined sends.
+            # (SendError subclasses ConnectionError, so this arm must
+            # precede the connection-failure arm.)
+            self._pending.pop(rid, None)
+            raise
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
             self._pending.pop(rid, None)
             self._fail_pending(SendError(str(e)))
@@ -158,12 +168,17 @@ class TransportClient:
             self._pending.pop(rid, None)
             raise
 
+    @property
+    def checksum_enabled(self) -> bool:
+        return self._checksum
+
     async def send_data(
         self,
         payload_bufs: List,
         upstream_seq_id: str,
         downstream_seq_id: str,
         metadata: Optional[Dict[str, str]] = None,
+        crc: Optional[int] = None,
     ) -> str:
         """Push one DATA message with retry policy; returns the ACK result."""
         payload_len = wire.payload_nbytes(payload_bufs)
@@ -181,6 +196,16 @@ class TransportClient:
             "down": str(downstream_seq_id),
             "meta": merged_meta,
         }
+        if crc is None and self._checksum:
+            # Prefer passing ``crc`` precomputed off-loop (the manager's
+            # codec pool does) — this inline path serves direct callers.
+            from rayfed_tpu import native
+
+            crc = 0
+            for buf in payload_bufs:
+                crc = native.crc32c(buf, seed=crc)
+        if crc is not None:
+            header["crc"] = crc
         policy = self._retry_policy
         backoff = policy.initial_backoff_s
         last_exc: Optional[Exception] = None

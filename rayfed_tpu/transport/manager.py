@@ -113,9 +113,15 @@ class TransportManager:
 
     def _merged_options(self, dest_party: str) -> Dict[str, Any]:
         """Per-destination options, per-party overriding global (ref :250-268)."""
+        from rayfed_tpu import native
+
         opts: Dict[str, Any] = {
             "timeout_s": self._job.cross_silo_timeout_s,
             "max_message_size": self._job.cross_silo_messages_max_size,
+            # Default on only when the fast C++ path built; the pure-
+            # Python CRC is ~MB/s and would stall large pushes.  Explicit
+            # per-party {"checksum": True} still forces it.
+            "checksum": native.is_available(),
         }
         party_opts = dict(self._cluster.party_config(dest_party).transport_options)
         # Accept reference-style gRPC channel-arg keys for drop-in compat.
@@ -145,6 +151,7 @@ class TransportManager:
                     max_message_size=int(opts["max_message_size"]),
                     metadata=self.merged_metadata(dest_party),
                     ssl_context=tls_utils.client_ssl_context(self._cluster.tls_config),
+                    checksum=bool(opts.get("checksum", True)),
                 )
                 self._clients[dest_party] = client
             return client
@@ -173,9 +180,17 @@ class TransportManager:
                 nbytes = wire.payload_nbytes(bufs)
                 t0 = time.perf_counter()
                 client = self._get_client(dest_party)
+                crc = None
+                if client.checksum_enabled:
+                    # Checksum on the codec thread, not the event loop.
+                    from rayfed_tpu import native
+
+                    crc = 0
+                    for buf in bufs:
+                        crc = native.crc32c(buf, seed=crc)
                 cf = asyncio.run_coroutine_threadsafe(
                     client.send_data(bufs, str(upstream_seq_id),
-                                     str(downstream_seq_id)),
+                                     str(downstream_seq_id), crc=crc),
                     self._loop,
                 )
 

@@ -89,6 +89,30 @@ class TransportServer:
                     break
                 payload = await reader.readexactly(plen) if plen else b""
 
+                expected_crc = header.get("crc")
+                if expected_crc is not None and msg_type == wire.MSG_DATA:
+                    from rayfed_tpu import native
+
+                    # Off-loop so a multi-MB checksum never blocks other
+                    # connections' frames (per-connection order is kept —
+                    # we await before reading the next frame).
+                    actual = await asyncio.get_running_loop().run_in_executor(
+                        None, native.crc32c, payload
+                    )
+                    if actual != expected_crc:
+                        # Retryable: corruption is transient; the sender's
+                        # retry policy re-pushes the frame.
+                        self.stats["receive_crc_errors"] = (
+                            self.stats.get("receive_crc_errors", 0) + 1
+                        )
+                        await self._reply(
+                            writer, wire.MSG_ERR,
+                            {"rid": header.get("rid"),
+                             "error": f"payload checksum mismatch "
+                                      f"({actual:#x} != {expected_crc:#x})"},
+                        )
+                        continue
+
                 if msg_type == wire.MSG_DATA:
                     message = Message(
                         src_party=header.get("src", "?"),

@@ -111,3 +111,35 @@ def test_flash_offset_gradients():
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for gf, gd in zip(g_flash, g_dense):
         np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_partially_masked_rows():
+    """kv block overlaps some q rows but not others: fully-masked rows must
+    be exactly zero (fwd) with zero grads (bwd), not mean-of-V / sum-of-dO."""
+    q, k, v = _qkv(jax.random.PRNGKey(8), t=16, h=1, d=8)
+    # q rows 0..7 see no keys (kv starts at global pos 8); rows 8..15 do.
+    out = flash_attention(
+        q, k, v, causal=True, q_offset=0, kv_offset=8, block_q=16, block_k=16
+    )
+    expected = dot_product_attention(q, k, v, causal=True, q_offset=0, kv_offset=8)
+    np.testing.assert_allclose(out, expected, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(out[:, :8], np.zeros_like(out[:, :8]), atol=1e-6)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, q_offset=0, kv_offset=8,
+                block_q=16, block_k=16,
+            ) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, causal=True, q_offset=0, kv_offset=8) ** 2
+        )
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)
+        assert not np.any(np.isnan(gf))

@@ -1,0 +1,86 @@
+"""Native (C++) wire data plane: crc32c, gather_copy, transport integration."""
+
+import numpy as np
+import pytest
+
+from rayfed_tpu import native
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / standard CRC32-C test vector.
+    assert native.crc32c(b"123456789") == 0xE3069283
+    assert native.crc32c(b"") == 0
+    assert native._crc32c_py(b"123456789") == 0xE3069283
+
+
+def test_crc32c_chaining_equals_whole():
+    data = np.random.default_rng(0).integers(0, 255, 10_001, dtype=np.uint8)
+    data = data.tobytes()
+    whole = native.crc32c(data)
+    chained = native.crc32c(data[4096:], seed=native.crc32c(data[:4096]))
+    assert whole == chained
+    if native.is_available():
+        assert whole == native._crc32c_py(data)
+
+
+def test_gather_copy_and_crc():
+    bufs = [b"abc", bytearray(b"defg"), np.arange(5, dtype=np.uint8)]
+    expect = b"abcdefg" + bytes(range(5))
+    out = native.gather_copy(bufs)
+    assert bytes(out) == expect
+    out2, crc = native.gather_copy(bufs, with_crc=True)
+    assert bytes(out2) == expect
+    assert crc == native.crc32c(expect)
+
+
+def test_gather_copy_handles_views_and_dtypes():
+    arr = np.arange(16, dtype=np.float32)
+    out = native.gather_copy([arr, memoryview(b"xy")])
+    assert bytes(out) == arr.tobytes() + b"xy"
+
+
+def test_transport_checksum_end_to_end():
+    """Corrupted payload must be rejected (retryable) by the server."""
+    import asyncio
+
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig, RetryPolicy
+    from rayfed_tpu.transport.manager import TransportManager
+
+    from tests.multiproc import get_free_ports
+
+    (port,) = get_free_ports(1)
+    cluster = ClusterConfig(
+        parties={"solo": PartyConfig.from_dict({"address": f"127.0.0.1:{port}"})},
+        current_party="solo",
+    )
+    job = JobConfig(retry_policy=RetryPolicy(max_attempts=2, initial_backoff_s=0.05))
+    tm = TransportManager(cluster, job)
+    tm.start()
+    try:
+        ref = tm.recv("solo", "u1", "d1")
+        assert tm.send("solo", {"x": 123}, "u1", "d1").resolve(timeout=10) is True
+        assert ref.resolve(timeout=10) == {"x": 123}
+
+        # Now forge a frame with a bad crc directly through the client.
+        client = tm._get_client("solo")
+
+        async def _bad_send():
+            from rayfed_tpu.transport import wire
+
+            payload = wire.encode_payload({"x": 1})
+            flat = b"".join(bytes(b) for b in payload)
+            header = {"src": "solo", "up": "u2", "down": "d2", "meta": {},
+                      "crc": native.crc32c(flat) ^ 0xDEADBEEF}
+            try:
+                await client._roundtrip(wire.MSG_DATA, header, [flat])
+                return "accepted"
+            except Exception as e:
+                return f"rejected: {e}"
+
+        import concurrent.futures
+        fut = asyncio.run_coroutine_threadsafe(_bad_send(), tm._loop)
+        result = fut.result(timeout=10)
+        assert "rejected" in result and "checksum" in result, result
+        assert tm._server.stats.get("receive_crc_errors", 0) == 1
+    finally:
+        tm.stop()
