@@ -25,6 +25,7 @@ Public API surface mirrors the reference (``fed/__init__.py:15-29``):
 
 from rayfed_tpu.api import init, shutdown, remote, get, kill
 from rayfed_tpu.fed_object import FedObject
+from rayfed_tpu.metrics import get_stats
 from rayfed_tpu.proxy import send, recv
 from rayfed_tpu import tree_util
 
@@ -40,5 +41,6 @@ __all__ = [
     "recv",
     "FedObject",
     "tree_util",
+    "get_stats",
     "__version__",
 ]

@@ -1,0 +1,112 @@
+"""Metrics, per-transfer instrumentation, and profiler hooks.
+
+The reference keeps only two op counters on its proxy actors
+(``_stats["send_op_count"]`` / ``_stats["receive_op_count"]``,
+``barriers.py:200,296``) exposed via ``_get_stats``.  Here observability
+is a real subsystem:
+
+- :func:`get_stats` — aggregate runtime stats (op counts, bytes,
+  seconds, effective GB/s, pending recvs, crc errors) from the party's
+  transport; superset of the reference's counters.
+- :class:`TransferLog` — optional per-transfer records (peer, seq ids,
+  bytes, seconds) with a bounded ring buffer, for the GB/s north-star
+  analysis.
+- :func:`trace_span` — ``jax.profiler.TraceAnnotation`` context manager
+  so framework phases (encode/send/recv/decode, fedavg rounds) show up
+  on TPU profiler timelines.
+- :func:`start_profile` / :func:`stop_profile` — thin wrappers over
+  ``jax.profiler`` trace capture.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from rayfed_tpu.runtime import get_runtime_or_none
+
+TransferRecord = collections.namedtuple(
+    "TransferRecord", ["direction", "peer", "up_id", "down_id", "nbytes", "seconds"]
+)
+
+
+class TransferLog:
+    """Bounded ring of per-transfer records (thread-safe)."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self._records: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, direction, peer, up_id, down_id, nbytes, seconds) -> None:
+        with self._lock:
+            self._records.append(
+                TransferRecord(direction, peer, str(up_id), str(down_id),
+                               int(nbytes), float(seconds))
+            )
+
+    def records(self):
+        with self._lock:
+            return list(self._records)
+
+    def throughput_gbps(self, direction: Optional[str] = None) -> float:
+        recs = [
+            r for r in self.records()
+            if (direction is None or r.direction == direction) and r.seconds > 0
+        ]
+        if not recs:
+            return 0.0
+        return sum(r.nbytes for r in recs) / sum(r.seconds for r in recs) / 1e9
+
+
+_global_transfer_log = TransferLog()
+
+
+def get_transfer_log() -> TransferLog:
+    return _global_transfer_log
+
+
+def get_stats() -> Dict[str, Any]:
+    """Aggregate stats for the current party's runtime.
+
+    Superset of the reference's proxy ``_get_stats``: send/receive op
+    counts plus bytes, wall seconds, and effective send GB/s.
+    """
+    runtime = get_runtime_or_none()
+    if runtime is None or getattr(runtime, "transport", None) is None:
+        return {}
+    stats = dict(runtime.transport.get_stats())
+    secs = stats.get("send_seconds", 0.0)
+    stats["send_gbps"] = (stats.get("send_bytes", 0) / secs / 1e9) if secs else 0.0
+    return stats
+
+
+@contextlib.contextmanager
+def trace_span(name: str, **kwargs):
+    """Annotate a block on the jax profiler timeline (no-op cost when no
+    trace is being captured)."""
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        yield
+
+
+def start_profile(log_dir: str) -> None:
+    """Begin a jax profiler capture (TensorBoard-viewable)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profile() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(out: Dict[str, float], key: str):
+    """Accumulate wall time of a block into ``out[key]``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        out[key] = out.get(key, 0.0) + (time.perf_counter() - t0)

@@ -17,6 +17,6 @@ Families cover the BASELINE.md configs:
 - :mod:`lora`      — LoRA adapters over any linear param (config #4)
 """
 
-from rayfed_tpu.models import bert, llama, logistic, lora, resnet
+from rayfed_tpu.models import bert, llama, logistic, lora, moe, resnet
 
-__all__ = ["logistic", "resnet", "bert", "llama", "lora"]
+__all__ = ["logistic", "resnet", "bert", "llama", "lora", "moe"]

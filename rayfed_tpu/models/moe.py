@@ -1,0 +1,119 @@
+"""Mixture-of-Experts layer with expert parallelism over the ``ep`` axis.
+
+Completes the SURVEY §2.10 parallelism checklist (DP/FSDP/TP/SP/PP/
+ring/Ulysses live elsewhere; EP lives here).  TPU-first design: experts
+are a stacked weight tensor ``[E, d, f]`` sharded over ``ep`` on dim 0;
+routing uses dense top-k with a capacity factor so every shape is static
+(XLA-friendly — no data-dependent gathers), and token dispatch/combine
+are einsums against a one-hot dispatch mask, which XLA lowers to
+all-to-alls when tokens and experts live on different mesh axes.
+
+Gating: top-k softmax gating with auxiliary load-balancing loss
+(Switch/GShard style).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    d_model: int = 64
+    d_ff: int = 256
+    aux_loss_weight: float = 0.01
+
+
+def init_moe(key: jax.Array, config: MoeConfig) -> Params:
+    e, d, f = config.num_experts, config.d_model, config.d_ff
+    k_gate, k_in, k_out = jax.random.split(key, 3)
+    return {
+        "gate": jax.random.normal(k_gate, (d, e)) * d**-0.5,
+        "w_in": jax.random.normal(k_in, (e, d, f)) * d**-0.5,
+        "w_out": jax.random.normal(k_out, (e, f, d)) * f**-0.5,
+    }
+
+
+# Experts shard over ep; inner dims over tp when present.
+PARTITION_RULES = (
+    (r"w_(in|out)$", P("ep", None, "tp")),
+    (r"gate$", P(None, None)),
+)
+
+
+def apply_moe(
+    params: Params,
+    x: jax.Array,
+    config: MoeConfig,
+    *,
+    return_aux: bool = False,
+):
+    """[B, T, d] → [B, T, d] with top-k expert routing.
+
+    Static-shape dispatch: every expert processes a fixed capacity
+    ``C = ceil(k·T·cf / E)`` tokens per batch row; overflow tokens are
+    dropped (standard Switch behavior) and their output falls back to 0
+    for that expert slot (residual connections outside absorb this).
+    """
+    b, t, d = x.shape
+    e, k = config.num_experts, config.top_k
+    capacity = max(1, math.ceil(config.capacity_factor * k * t / e))
+
+    logits = x @ params["gate"].astype(x.dtype)  # [B, T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [B, T, k]
+
+    # Position of each (token, choice) within its expert's capacity buffer.
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [B, T, k, E]
+    flat = onehot.reshape(b, t * k, e)
+    pos_in_expert = jnp.cumsum(flat, axis=1) * flat  # 1-based rank
+    pos_in_expert = pos_in_expert.reshape(b, t, k, e) - 1
+    keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+
+    # Dispatch mask [B, T, k, E, C] — one-hot over capacity slots.
+    pos_clamped = jnp.clip(pos_in_expert, 0, capacity - 1)
+    dispatch = (
+        jax.nn.one_hot(pos_clamped, capacity, dtype=x.dtype)
+        * keep[..., None].astype(x.dtype)
+        * onehot[..., None].astype(x.dtype)
+    )  # [B, T, k, E, C]
+    dispatch_tok = dispatch.sum(axis=2)  # [B, T, E, C]
+    combine = (
+        dispatch * gate_vals[..., None, None].astype(x.dtype)
+    ).sum(axis=2)  # [B, T, E, C]
+
+    # Route tokens to expert buffers: [B, E, C, d].
+    expert_in = jnp.einsum("btec,btd->becd", dispatch_tok, x)
+    # Expert FFN (stacked weights; E is a batched matmul dim on the MXU).
+    h = jax.nn.gelu(
+        jnp.einsum("becd,edf->becf", expert_in, params["w_in"].astype(x.dtype))
+    )
+    expert_out = jnp.einsum("becf,efd->becd", h, params["w_out"].astype(x.dtype))
+    # Combine back, weighted by gate values.
+    out = jnp.einsum("btec,becd->btd", combine, expert_out)
+
+    if not return_aux:
+        return out
+    # Load-balancing auxiliary loss (Switch eq. 4): E * sum_e f_e * P_e.
+    top1 = expert_idx[..., 0]
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=(0, 1)
+    )
+    frac_probs = jnp.mean(probs, axis=(0, 1))
+    aux = config.aux_loss_weight * e * jnp.sum(frac_tokens * frac_probs)
+    return out, {
+        "aux_loss": aux,
+        "dropped_fraction": 1.0
+        - jnp.mean(keep.any(axis=-1).astype(jnp.float32)),
+    }

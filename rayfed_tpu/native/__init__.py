@@ -127,6 +127,14 @@ def _addr_of(mv: memoryview, keepalive: List) -> int:
 # ---------------------------------------------------------------------------
 
 
+def crc32c_multi(buffers: Sequence) -> int:
+    """Chained CRC32-C over a sequence of buffers == crc of their concat."""
+    crc = 0
+    for buf in buffers:
+        crc = crc32c(buf, seed=crc)
+    return crc
+
+
 def crc32c(data, seed: int = 0) -> int:
     """CRC32-C (Castagnoli) of a bytes-like object."""
     lib = _load()

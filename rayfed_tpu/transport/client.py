@@ -201,9 +201,7 @@ class TransportClient:
             # codec pool does) — this inline path serves direct callers.
             from rayfed_tpu import native
 
-            crc = 0
-            for buf in payload_bufs:
-                crc = native.crc32c(buf, seed=crc)
+            crc = native.crc32c_multi(payload_bufs)
         if crc is not None:
             header["crc"] = crc
         policy = self._retry_policy

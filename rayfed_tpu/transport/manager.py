@@ -76,6 +76,12 @@ class TransportManager:
             self._started.set()
             self._loop.run_forever()
 
+        # Warm the native codec build up front so the first transfer never
+        # pays (or serializes behind) a g++ compile inside _get_client.
+        from rayfed_tpu import native
+
+        native.is_available()
+
         self._loop_thread = threading.Thread(
             target=_run_loop, name=f"rayfed-transport-{self._party}", daemon=True
         )
@@ -185,9 +191,7 @@ class TransportManager:
                     # Checksum on the codec thread, not the event loop.
                     from rayfed_tpu import native
 
-                    crc = 0
-                    for buf in bufs:
-                        crc = native.crc32c(buf, seed=crc)
+                    crc = native.crc32c_multi(bufs)
                 cf = asyncio.run_coroutine_threadsafe(
                     client.send_data(bufs, str(upstream_seq_id),
                                      str(downstream_seq_id), crc=crc),
@@ -197,8 +201,15 @@ class TransportManager:
                 def _done(f):
                     try:
                         f.result()
+                        dt = time.perf_counter() - t0
                         self.stats["send_bytes"] += nbytes
-                        self.stats["send_seconds"] += time.perf_counter() - t0
+                        self.stats["send_seconds"] += dt
+                        from rayfed_tpu import metrics
+
+                        metrics.get_transfer_log().record(
+                            "send", dest_party, upstream_seq_id,
+                            downstream_seq_id, nbytes, dt,
+                        )
                         out_ref.set_result(True)
                     except Exception as e:
                         logger.warning(
@@ -260,6 +271,15 @@ class TransportManager:
                 try:
                     value = wire.decode_payload(
                         message.payload, allowed=allowed, device_put=device_put
+                    )
+                    from rayfed_tpu import metrics
+
+                    # Denominator = socket-read wall time (honest wire GB/s
+                    # at the receiver); decode runs here but is not billed.
+                    metrics.get_transfer_log().record(
+                        "recv", message.src_party, upstream_seq_id,
+                        downstream_seq_id, len(message.payload),
+                        message.read_seconds,
                     )
                     out_ref.set_result(value)
                 except Exception as e:

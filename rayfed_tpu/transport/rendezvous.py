@@ -25,6 +25,9 @@ class Message:
     downstream_seq_id: str
     payload: bytes
     metadata: Dict[str, str]
+    # Wall time the receiver spent reading the payload off the socket —
+    # the honest denominator for receiver-side GB/s.
+    read_seconds: float = 0.0
 
 
 class _Entry:

@@ -12,6 +12,7 @@ import asyncio
 import json
 import logging
 import ssl
+import time
 from typing import Any, Callable, Dict, Optional
 
 from rayfed_tpu.transport import wire
@@ -39,6 +40,7 @@ class TransportServer:
         self._ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._on_message = on_message
+        self._warned_no_native_crc = False
         self.stats: Dict[str, Any] = {"receive_op_count": 0, "receive_bytes": 0}
 
     async def start(self) -> None:
@@ -87,9 +89,26 @@ class TransportServer:
                                   f"{self._max_message_size}"},
                     )
                     break
+                t_read = time.perf_counter()
                 payload = await reader.readexactly(plen) if plen else b""
+                read_seconds = time.perf_counter() - t_read
 
                 expected_crc = header.get("crc")
+                if expected_crc is not None and msg_type == wire.MSG_DATA:
+                    from rayfed_tpu import native
+
+                    if not native.is_available():
+                        # The crc header is advisory: without the fast C++
+                        # path, verifying at ~MB/s python speed would stall
+                        # this connection — trust TCP integrity instead.
+                        if not self._warned_no_native_crc:
+                            self._warned_no_native_crc = True
+                            logger.warning(
+                                "[%s] peer sends checksums but native codec "
+                                "is unavailable; skipping verification",
+                                self._party,
+                            )
+                        expected_crc = None
                 if expected_crc is not None and msg_type == wire.MSG_DATA:
                     from rayfed_tpu import native
 
@@ -120,6 +139,7 @@ class TransportServer:
                         downstream_seq_id=str(header.get("down")),
                         payload=payload,
                         metadata=header.get("meta", {}),
+                        read_seconds=read_seconds,
                     )
                     self.stats["receive_op_count"] += 1
                     self.stats["receive_bytes"] += len(payload)

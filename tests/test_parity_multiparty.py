@@ -1,0 +1,220 @@
+"""Reference-parity multi-party semantics tests.
+
+Mirrors the reference suite's object-passing semantics
+(``test_basic_pass_fed_objects.py``,
+``test_pass_fed_objects_in_containers_*.py``,
+``test_cache_fed_objects.py``) plus >2-party broadcast-on-get dedup
+(the hard part per SURVEY §7).
+"""
+
+import numpy as np
+
+from tests.multiproc import make_cluster, run_parties
+
+CLUSTER_AB = make_cluster(["alice", "bob"])
+CLUSTER_3 = make_cluster(["alice", "bob", "carol"])
+CLUSTER_ALLOWLIST = make_cluster(["alice", "bob"])
+
+
+# --- basic pass both directions ---------------------------------------------
+
+
+def run_basic_pass(party, cluster):
+    import rayfed_tpu as fed
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    def produce(tag):
+        return f"data-from-{tag}"
+
+    @fed.remote
+    def consume(x, y):
+        return f"consumed({x},{y})"
+
+    a = produce.party("alice").remote("alice")
+    b = produce.party("bob").remote("bob")
+    # alice's object consumed on bob AND bob's consumed on alice.
+    on_bob = consume.party("bob").remote(a, b)
+    on_alice = consume.party("alice").remote(a, b)
+    assert fed.get(on_bob) == "consumed(data-from-alice,data-from-bob)"
+    assert fed.get(on_alice) == "consumed(data-from-alice,data-from-bob)"
+    fed.shutdown()
+
+
+def test_basic_pass_fed_objects():
+    run_parties(run_basic_pass, ["alice", "bob"], args=(CLUSTER_AB,))
+
+
+# --- containers: nested FedObjects are NOT auto-resolved ---------------------
+
+
+def run_containers(party, cluster):
+    import rayfed_tpu as fed
+    from rayfed_tpu.executor import LocalRef
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    def produce():
+        return 41
+
+    @fed.remote
+    def consume_container(objs):
+        # Parity with reference semantics: a fed object nested inside a
+        # container is swapped for an in-party ref but NOT materialized
+        # (the reference's task body sees a raw ray.ObjectRef,
+        # ``test_pass_fed_objects_in_containers_in_normal_tasks.py:28-35``);
+        # the task body fed.gets it.
+        assert isinstance(objs, list) and isinstance(objs[0], LocalRef), objs
+        return fed.get(objs[0]) + 1
+
+    @fed.remote
+    class Holder:
+        def feed(self, objs):
+            assert isinstance(objs[0], LocalRef), objs
+            return fed.get(objs[0]) + 2
+
+    obj = produce.party("alice").remote()
+    out = consume_container.party("bob").remote([obj])
+    assert fed.get(out) == 42
+
+    holder = Holder.party("bob").remote()
+    out2 = holder.feed.remote([obj])
+    assert fed.get(out2) == 43
+    fed.shutdown()
+
+
+def test_pass_fed_objects_in_containers():
+    run_parties(run_containers, ["alice", "bob"], args=(CLUSTER_AB,))
+
+
+# --- exactly-once send dedup -------------------------------------------------
+
+
+def run_cache(party, cluster):
+    import rayfed_tpu as fed
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    def produce():
+        return np.arange(10)
+
+    @fed.remote
+    def consume(x):
+        return int(np.sum(x))
+
+    obj = produce.party("alice").remote()
+    # Consume the same object on bob three times + fed.get it twice:
+    # alice must push it exactly once per (object, dest) per new seq id
+    # consumer... reference semantics: one send per consumption site is
+    # avoided by the sending context — the object is sent once to bob.
+    r1 = consume.party("bob").remote(obj)
+    r2 = consume.party("bob").remote(obj)
+    r3 = consume.party("bob").remote(obj)
+    assert fed.get([r1, r2, r3]) == [45, 45, 45]
+    v1 = fed.get(obj)
+    v2 = fed.get(obj)
+    assert int(np.sum(v1)) == int(np.sum(v2)) == 45
+
+    stats = fed.get_stats()
+    if party == "alice":
+        # produce-result pushed to bob exactly once (consumption dedup)
+        # plus at most one broadcast push for the two fed.gets.
+        assert stats["send_op_count"] <= 2, stats
+    fed.shutdown()
+
+
+def test_cache_fed_objects_exactly_once():
+    run_parties(run_cache, ["alice", "bob"], args=(CLUSTER_AB,))
+
+
+# --- 3-party broadcast-on-get dedup ------------------------------------------
+
+
+def run_three_party_get(party, cluster):
+    import rayfed_tpu as fed
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    def produce():
+        return {"w": np.ones((4,)), "n": 3}
+
+    obj = produce.party("alice").remote()
+    # Every party gets the value; owner pushes to BOTH peers exactly once.
+    val = fed.get(obj)
+    assert val["n"] == 3 and np.allclose(val["w"], 1.0)
+    # Second get must not re-push (cached on receivers, dedup on owner).
+    val2 = fed.get(obj)
+    assert val2["n"] == 3
+
+    stats = fed.get_stats()
+    if party == "alice":
+        assert stats["send_op_count"] == 2, stats  # one per peer
+    else:
+        assert stats.get("receive_op_count", 0) == 1, stats
+    fed.shutdown()
+
+
+def test_three_party_broadcast_on_get():
+    run_parties(run_three_party_get, ["alice", "bob", "carol"], args=(CLUSTER_3,))
+
+
+# --- serialization allowlist across parties ----------------------------------
+
+
+class Evil:
+    """Not on the allowlist — deserialization on the receiver must fail."""
+
+    def __init__(self):
+        self.x = 1
+
+
+def run_allowlist(party, cluster):
+    import pickle
+
+    import pytest
+
+    import rayfed_tpu as fed
+
+    fed.init(
+        address="local",
+        cluster=cluster,
+        party=party,
+        cross_silo_serializing_allowed_list={"numpy": "*", "numpy.core.numeric": "*"},
+        cross_silo_timeout_in_seconds=10,
+        cross_silo_retry_policy={"maxAttempts": 2, "initialBackoff": "0.2s"},
+    )
+
+    @fed.remote
+    def produce_np():
+        return np.ones((3,))
+
+    @fed.remote
+    def produce_evil():
+        return Evil()
+
+    @fed.remote
+    def consume(x):
+        return x
+
+    # numpy is allowlisted: crosses fine.
+    ok = consume.party("bob").remote(produce_np.party("alice").remote())
+    assert float(np.sum(fed.get(ok))) == 3.0
+
+    # custom class is rejected at the receiving side (reference
+    # serializations_tests/test_unpickle_with_whitelist.py:39-73).
+    bad = consume.party("bob").remote(produce_evil.party("alice").remote())
+    if party == "bob":
+        with pytest.raises(Exception) as ei:
+            fed.get(bad, timeout=30)
+        assert isinstance(ei.value, pickle.UnpicklingError) or "forbidden" in str(
+            ei.value
+        ).lower(), ei.value
+    fed.shutdown()
+
+
+def test_allowlist_across_parties():
+    run_parties(run_allowlist, ["alice", "bob"], args=(CLUSTER_ALLOWLIST,))

@@ -1,0 +1,112 @@
+"""Self-signed TLS cert generator for tests and local multi-party setups.
+
+Capability parity with the reference's ``tool/generate_tls_certs.py``
+(RSA-2048 self-signed certs with localhost/private-IP SANs, 365-day
+validity): generates one CA plus a CA-signed leaf cert/key usable by
+every party for mutual TLS, written to the output directory as
+``ca.crt``, ``server.crt``, ``server.key``.
+
+Usage::
+
+    python tool/generate_tls_certs.py [output_dir]
+
+Default output: ``/tmp/rayfed_tpu/test-certs``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import sys
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+DEFAULT_DIR = "/tmp/rayfed_tpu/test-certs"
+
+
+def _key() -> rsa.RSAPrivateKey:
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _name(cn: str) -> x509.Name:
+    return x509.Name(
+        [
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, "rayfed_tpu-test"),
+            x509.NameAttribute(NameOID.COMMON_NAME, cn),
+        ]
+    )
+
+
+def generate_self_signed_tls_certs(output_dir: str = DEFAULT_DIR) -> dict:
+    """Write ca.crt / server.crt / server.key; returns a tls_config dict."""
+    os.makedirs(output_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    ca_key = _key()
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("rayfed-tpu-test-ca"))
+        .issuer_name(_name("rayfed-tpu-test-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    leaf_key = _key()
+    san = x509.SubjectAlternativeName(
+        [
+            x509.DNSName("localhost"),
+            x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+            x509.IPAddress(ipaddress.ip_address("0.0.0.0")),
+        ]
+    )
+    leaf_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("rayfed-tpu-test-party"))
+        .issuer_name(ca_cert.subject)
+        .public_key(leaf_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(san, critical=False)
+        .add_extension(
+            x509.ExtendedKeyUsage(
+                [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+                 x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]
+            ),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    paths = {
+        "ca_cert": os.path.join(output_dir, "ca.crt"),
+        "cert": os.path.join(output_dir, "server.crt"),
+        "key": os.path.join(output_dir, "server.key"),
+    }
+    with open(paths["ca_cert"], "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths["cert"], "wb") as f:
+        f.write(leaf_cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths["key"], "wb") as f:
+        f.write(
+            leaf_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    return paths
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_DIR
+    paths = generate_self_signed_tls_certs(out)
+    print("\n".join(f"{k}: {v}" for k, v in paths.items()))
