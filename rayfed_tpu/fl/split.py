@@ -6,76 +6,161 @@ BASELINE.md config #5: encoder@alice → head@bob.  Per step:
    (owner-initiated, per the framework's push perimeter);
 2. head party computes loss + gradient w.r.t. activations, updates its
    head params, pushes the activation gradient back;
-3. encoder party closes its saved VJP and updates encoder params.
+3. encoder party closes the backward (recompute-in-jit) and updates.
 
 Both halves keep params on their own devices between steps (actor
 state); only [B, D] activations and their gradients cross the silo
 boundary each step — this is the "activation push GB/s" path the
 benchmark measures.
+
+Two stepping modes:
+
+- :meth:`SplitTrainer.step` — one batch, strictly serialized
+  (fwd → push → head → push → bwd).  Latency per step is the full
+  round trip; simple semantics.
+- :meth:`SplitTrainer.step_pipelined` — GPipe-style microbatching
+  *across the silo boundary*: all K encoder forwards are issued
+  back-to-back (activation pushes stream while the next microbatch
+  computes), head steps run as activations land, activation-gradients
+  stream back, and both halves **accumulate** gradients, applying one
+  update at the end — numerically the same step as one big batch, but
+  the wire and both parties' compute overlap instead of taking turns.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, List, Sequence
 
 import jax
 
 
-class _EncoderActor:
-    """Party-local encoder half: forward + deferred backward via VJP."""
+class _GradAccum:
+    """Shared accumulate-then-apply state for both split halves.
 
-    def __init__(self, params: Any, apply_fn: Callable, lr: float):
-        self._params = params
-        self._apply = apply_fn
-        self._lr = lr
-        self._vjp = None
+    Holds the running gradient sum and a pair of jitted helpers; the
+    final update applies ``lr * mean(grads)`` once (GPipe semantics —
+    identical to one step on the concatenated batch).
+    """
 
-    def forward(self, x):
-        out, vjp = jax.vjp(lambda p: self._apply(p, x), self._params)
-        self._vjp = vjp
-        return out
+    def __init__(self, lr: float):
+        self._acc = None
+        self._count = 0
 
-    def backward(self, g):
-        if self._vjp is None:
-            raise RuntimeError("backward called before forward")
-        (grads,) = self._vjp(g)
-        self._params = jax.tree_util.tree_map(
-            lambda p, gr: p - self._lr * gr, self._params, grads
-        )
-        self._vjp = None
+        def _add(acc, grads):
+            return jax.tree_util.tree_map(jax.numpy.add, acc, grads)
+
+        def _apply(params, acc, count):
+            return jax.tree_util.tree_map(
+                lambda p, a: p - lr * a / count, params, acc
+            )
+
+        self._add = jax.jit(_add, donate_argnums=(0,))
+        self._apply_jit = jax.jit(_apply, donate_argnums=(1,))
+
+    def add(self, grads) -> None:
+        self._acc = grads if self._acc is None else self._add(self._acc, grads)
+        self._count += 1
+
+    def apply(self, params):
+        """Returns updated params, or ``None`` when nothing accumulated."""
+        if self._acc is None:
+            return None
+        params = self._apply_jit(params, self._acc, float(self._count))
+        self._acc = None
+        self._count = 0
+        return params
+
+
+class _SplitHalf:
+    """Shared actor plumbing: params + accumulator + apply/get."""
+
+    _params: Any
+    _accum: _GradAccum
+
+    def apply_update(self):
+        updated = self._accum.apply(self._params)
+        if updated is None:
+            return False
+        self._params = updated
         return True
 
     def get_params(self):
         return self._params
 
 
-class _HeadActor:
+class _EncoderActor(_SplitHalf):
+    """Party-local encoder half: jitted forward + jitted recompute-backward.
+
+    Both halves of the step compile exactly once.  The backward
+    rematerializes the forward pass inside jit rather than holding a
+    Python VJP closure across steps — an un-jitted ``jax.vjp`` would
+    retrace the encoder every step (the round-1 0.01 GB/s bottleneck),
+    while recompute-in-jit costs one fused extra forward on the MXU.
+
+    Supports many microbatches in flight: each ``forward`` saves its
+    input under a microbatch id; ``backward`` produces that microbatch's
+    param grads and accumulates them; ``apply_update`` applies the mean
+    accumulated gradient once (GPipe-style accumulate-then-apply).
+    """
+
+    def __init__(self, params: Any, apply_fn: Callable, lr: float):
+        self._params = params
+        self._saved: Dict[int, Any] = {}
+        self._accum = _GradAccum(lr)
+
+        def _fwd(params, x):
+            return apply_fn(params, x)
+
+        def _grads(params, x, g):
+            _, vjp = jax.vjp(lambda p: apply_fn(p, x), params)
+            (grads,) = vjp(g)
+            return grads
+
+        self._fwd = jax.jit(_fwd)
+        self._grads = jax.jit(_grads)
+
+    def forward(self, x, microbatch: int = 0):
+        self._saved[microbatch] = x
+        return self._fwd(self._params, x)
+
+    def backward(self, g, microbatch: int = 0):
+        x = self._saved.pop(microbatch, None)
+        if x is None:
+            raise RuntimeError(
+                f"backward for microbatch {microbatch} before its forward"
+            )
+        self._accum.add(self._grads(self._params, x, g))
+        return True
+
+
+class _HeadActor(_SplitHalf):
     """Party-local head half: loss + grads for both head and activations."""
 
     def __init__(self, params: Any, apply_fn: Callable, loss_fn: Callable, lr: float):
         self._params = params
-        self._apply = apply_fn
-        self._loss = loss_fn
-        self._lr = lr
+        self._accum = _GradAccum(lr)
 
-        def _step(params, h, y):
+        def _grads(params, h, y):
             def f(params, h):
-                return self._loss(self._apply(params, h), y)
+                return loss_fn(apply_fn(params, h), y)
 
             loss, (g_params, g_h) = jax.value_and_grad(f, argnums=(0, 1))(params, h)
-            new_params = jax.tree_util.tree_map(
-                lambda p, g: p - lr * g, params, g_params
-            )
-            return new_params, g_h, loss
+            return g_params, g_h, loss
 
-        self._step = jax.jit(_step)
+        self._grads = jax.jit(_grads)
 
     def step(self, h, y):
-        self._params, g_h, loss = self._step(self._params, h, y)
+        """Grads + immediate update (the serialized one-batch path)."""
+        g_h, loss = self.step_accum(h, y)
+        self.apply_update()
         return g_h, loss
 
-    def get_params(self):
-        return self._params
+    def step_accum(self, h, y):
+        """Like :meth:`step` but accumulates the head grad instead of
+        applying it (microbatch pipelining)."""
+        g_params, g_h, loss = self._grads(self._params, h, y)
+        self._accum.add(g_params)
+        return g_h, loss
 
 
 class SplitTrainer:
@@ -119,7 +204,42 @@ class SplitTrainer:
         h = self._encoder.forward.remote(x_obj)
         g_h, loss = self._head.step.options(num_returns=2).remote(h, y_obj)
         self._encoder.backward.remote(g_h)
+        self._encoder.apply_update.remote()
         return loss
+
+    def step_pipelined(
+        self, x_objs: Sequence[Any], y_objs: Sequence[Any]
+    ) -> List[Any]:
+        """One *accumulated* split step over K microbatches with
+        transfer/compute overlap.
+
+        All K forwards are issued before any backward, so the encoder
+        party streams K activation pushes back-to-back while the head
+        party consumes them; activation-gradients stream back the same
+        way.  Both parties accumulate their param grads and apply a
+        single mean update at the end — the same mathematical step as
+        one batch of size ``sum(len(x))``, at pipeline throughput.
+
+        Returns the per-microbatch losses (FedObjects owned by the head
+        party).
+        """
+        if len(x_objs) != len(y_objs):
+            raise ValueError("need one y per x microbatch")
+        hs = [
+            self._encoder.forward.remote(x, mb)
+            for mb, x in enumerate(x_objs)
+        ]
+        losses = []
+        g_hs = []
+        for h, y in zip(hs, y_objs):
+            g_h, loss = self._head.step_accum.options(num_returns=2).remote(h, y)
+            g_hs.append(g_h)
+            losses.append(loss)
+        for mb, g_h in enumerate(g_hs):
+            self._encoder.backward.remote(g_h, mb)
+        self._encoder.apply_update.remote()
+        self._head.apply_update.remote()
+        return losses
 
     def encoder_params(self):
         return self._encoder.get_params.remote()

@@ -234,6 +234,23 @@ PARTITION_RULES = (
 )
 
 
+def _adam_update(params, grads, opt, lr, b1, b2, eps):
+    count, m, v = opt
+    count = count + 1
+    m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
+    v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, grads)
+    mhat_scale = 1.0 / (1 - b1**count)
+    vhat_scale = 1.0 / (1 - b2**count)
+    params = jax.tree_util.tree_map(
+        lambda p, m_, v_: p
+        - lr * (m_ * mhat_scale) / (jnp.sqrt(v_ * vhat_scale) + eps),
+        params,
+        m,
+        v,
+    )
+    return params, (count, m, v)
+
+
 def make_lora_train_step(
     config: LlamaConfig,
     lr: float = 1e-4,
@@ -256,24 +273,49 @@ def make_lora_train_step(
 
     def step_fn(lora, opt, base_params, ids):
         loss, grads = jax.value_and_grad(loss_fn)(lora, base_params, ids)
-        count, m, v = opt
-        count = count + 1
-        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
-        v = jax.tree_util.tree_map(
-            lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, grads
-        )
-        mhat_scale = 1.0 / (1 - b1**count)
-        vhat_scale = 1.0 / (1 - b2**count)
-        lora = jax.tree_util.tree_map(
-            lambda p, m_, v_: p
-            - lr * (m_ * mhat_scale) / (jnp.sqrt(v_ * vhat_scale) + eps),
-            lora,
-            m,
-            v,
-        )
-        return lora, (count, m, v), loss
+        lora, opt = _adam_update(lora, grads, opt, lr, b1, b2, eps)
+        return lora, opt, loss
 
     return jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+def make_train_step(
+    config: LlamaConfig,
+    lr: float = 3e-4,
+    *,
+    attn_fn: Callable = dot_product_attention,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+):
+    """Full-parameter Adam train step: (params, opt, ids) → (params, opt, loss).
+
+    Params and both Adam moments are donated — the step runs in place in
+    HBM, which is what lets the whole optimizer state stay device-resident
+    between steps (no host round-trips in the training loop).
+    """
+
+    def loss_fn(params, ids):
+        logits = apply_llama(params, ids, config, attn_fn=attn_fn)
+        return lm_loss(logits[:, :-1], ids[:, 1:])
+
+    def step_fn(params, opt, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+        params, opt = _adam_update(params, grads, opt, lr, b1, b2, eps)
+        return params, opt, loss
+
+    return jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+def param_count(params: Params, *, exclude_embed: bool = False) -> int:
+    """Total parameter count (optionally excluding the embedding table)."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = jax.tree_util.keystr(path)
+        if exclude_embed and "embed" in name:
+            continue
+        total += leaf.size
+    return total
 
 
 def init_adam(params: Params):

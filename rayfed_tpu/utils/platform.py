@@ -1,0 +1,33 @@
+"""Platform pinning helpers.
+
+The test/bench environment may register a real-accelerator PJRT plugin
+from ``sitecustomize`` and pin ``jax_platforms`` via ``jax.config`` at
+interpreter start — plain env vars don't win by then, so any process
+that wants a virtual CPU mesh must override through ``jax.config``
+*before* the first backend initialization.  This is the single home for
+that workaround (used by ``tests/multiproc.py``, ``bench.py`` party
+children, and the ``__graft_entry__`` dry-run re-exec).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Pin JAX to the CPU platform with ``n`` virtual devices.
+
+    Must run before any JAX backend initialization (e.g. first
+    ``jax.devices()`` / jit execution) in the calling process.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)

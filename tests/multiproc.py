@@ -40,13 +40,9 @@ def make_cluster(parties: Sequence[str], ports: Optional[Sequence[int]] = None) 
 
 def _child_entry(env: Dict[str, str], module: str, fn_name: str, party: str, args: tuple):
     os.environ.update(env)
-    # The axon sitecustomize pins jax_platforms via jax.config at interpreter
-    # start; env vars alone don't win.  Override through jax.config before
-    # any backend initialization.
-    import jax
+    from rayfed_tpu.utils import force_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    force_cpu_devices(8)
     import importlib
 
     run = getattr(importlib.import_module(module), fn_name)
