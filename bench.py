@@ -250,7 +250,15 @@ def _peak_flops() -> float:
 
 
 def bench_llama() -> dict:
-    """Full-param Adam train step of a ~250M Llama, bf16 + flash attention."""
+    """Full-param Adam training of a ~270M Llama, bf16 + flash attention.
+
+    All N steps run inside ONE compiled program (``lax.scan``) and the
+    per-step time is the **slope** between a short and a long run — on
+    this host the accelerator sits behind a network tunnel whose
+    per-dispatch round trip (~100 ms) would otherwise swamp the
+    measurement (and ``block_until_ready`` does not sync through it;
+    ``device_get`` of the final loss does).
+    """
     import jax.numpy as jnp
 
     from rayfed_tpu.models import llama
@@ -267,42 +275,59 @@ def bench_llama() -> dict:
         dtype=jnp.bfloat16,
     )
     batch, seq = 8, 1024
-    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
-    opt = llama.init_adam(params)
-    step = llama.make_train_step(cfg, attn_fn=flash_attention)
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
 
-    params = jax.device_put(params)
-    _log("  compiling llama train step...")
-    for _ in range(2):  # warmup/compile
-        params, opt, loss = step(params, opt, ids)
-    jax.block_until_ready(loss)
+    def timed_run(n_steps: int) -> float:
+        params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+        opt = llama.init_adam(params)
+        loop = llama.make_train_loop(cfg, n_steps, attn_fn=flash_attention)
+        params, opt, losses = loop(params, opt, ids)  # compile + warm
+        float(jax.device_get(losses[-1]))
+        params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+        opt = llama.init_adam(params)
+        _ = float(jax.device_get(jnp.zeros(())))  # drain queue
+        t0 = time.perf_counter()
+        params, opt, losses = loop(params, opt, ids)
+        final = float(jax.device_get(losses[-1]))
+        assert final == final, "loss is NaN"
+        return time.perf_counter() - t0
 
-    steps = 10
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt, loss = step(params, opt, ids)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - t0
+    _log("  compiling llama train loops (short+long)...")
+    n_short, n_long = 2, 12
+    t_short = timed_run(n_short)
+    t_long = timed_run(n_long)
+    step_time = max((t_long - t_short) / (n_long - n_short), 1e-9)
 
     tokens = batch * seq
-    tokens_per_sec = steps * tokens / elapsed
+    tokens_per_sec = tokens / step_time
     # Model FLOPs: 6 * matmul-params * tokens (fwd 2NT + bwd 4NT; the
     # embedding gather does no matmul FLOPs, lm_head does) plus causal
-    # attention 6 * L*B*T^2*d (12*L*B*T^2*d for full, halved causal).
-    n_matmul = llama.param_count(params, exclude_embed=True)
-    flops_per_step = 6 * n_matmul * tokens + 6 * cfg.num_layers * batch * seq**2 * cfg.hidden_size
-    mfu = flops_per_step * steps / elapsed / _peak_flops()
+    # attention 6 * L*B*T^2*d (12*L*B*T^2*d full, halved for causal).
+    # eval_shape counts without allocating another ~1GB model.
+    abstract = jax.eval_shape(
+        lambda: llama.init_llama(jax.random.PRNGKey(0), cfg)
+    )
+    n_matmul = llama.param_count(abstract, exclude_embed=True)
+    flops_per_step = (
+        6 * n_matmul * tokens
+        + 6 * cfg.num_layers * batch * seq**2 * cfg.hidden_size
+    )
+    mfu = flops_per_step / step_time / _peak_flops()
     return {
         "llama_tokens_per_sec": round(tokens_per_sec, 1),
         "llama_mfu": round(mfu, 4),
-        "llama_params_millions": round(llama.param_count(params) / 1e6, 1),
-        "llama_step_ms": round(elapsed / steps * 1e3, 2),
+        "llama_params_millions": round(llama.param_count(abstract) / 1e6, 1),
+        "llama_step_ms": round(step_time * 1e3, 2),
     }
 
 
 def bench_flash() -> dict:
-    """Flash (pallas) vs dense attention, fwd+bwd, causal, T=2048."""
+    """Flash (pallas) vs dense attention, fwd+bwd, causal, T=2048.
+
+    Same slope-timing discipline as :func:`bench_llama`: N chained
+    fwd+bwd iterations inside one jitted ``lax.scan``, per-iter time
+    from the slope between short and long runs.
+    """
     import jax.numpy as jnp
 
     from rayfed_tpu.ops.attention import dot_product_attention
@@ -310,7 +335,7 @@ def bench_flash() -> dict:
 
     b, t, h, dh = 4, 2048, 16, 64
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (
+    q0, k0, v0 = (
         jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16) for kk in keys
     )
 
@@ -318,16 +343,31 @@ def bench_flash() -> dict:
         def loss(q, k, v):
             return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
 
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        jax.block_until_ready(g(q, k, v))  # compile
-        iters = 10
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = g(q, k, v)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
 
-    _log("  compiling flash/dense attention...")
+        def chain(n):
+            @jax.jit
+            def run(q, k, v):
+                def body(carry, _):
+                    q, k, v = carry
+                    gq, gk, gv = grad_fn(q, k, v)
+                    # Data dependency so scan iterations can't be elided.
+                    return (q - 1e-6 * gq, k - 1e-6 * gk, v - 1e-6 * gv), None
+
+                carry, _ = jax.lax.scan(body, (q, k, v), None, length=n)
+                return carry[0]
+
+            out = run(q0, k0, v0)  # compile + warm
+            float(jax.device_get(jnp.sum(out.astype(jnp.float32))))
+            t0 = time.perf_counter()
+            out = run(q0, k0, v0)
+            float(jax.device_get(jnp.sum(out.astype(jnp.float32))))
+            return time.perf_counter() - t0
+
+        n_short, n_long = 2, 12
+        return max((chain(n_long) - chain(n_short)) / (n_long - n_short), 1e-9)
+
+    _log("  compiling flash/dense attention chains...")
     dense_t = timed(dot_product_attention)
     flash_t = timed(flash_attention)
     return {

@@ -54,6 +54,8 @@ def init(
     exit_on_failure_cross_silo_sending: bool = False,
     cross_silo_messages_max_size_in_bytes: Optional[int] = None,
     cross_silo_timeout_in_seconds: float = 60,
+    recv_backstop_in_seconds: Optional[float] = None,
+    mailbox_ttl_in_seconds: Optional[float] = None,
     enable_waiting_for_other_parties_ready: bool = False,
     global_metadata: Optional[Dict] = None,
     grpc_metadata: Optional[Dict] = None,  # reference-compat alias
@@ -62,6 +64,9 @@ def init(
     max_workers: int = 16,
     device_put_received: bool = True,
     process_default: bool = True,
+    coordinator_address: Optional[str] = None,
+    num_party_processes: Optional[int] = None,
+    party_process_id: Optional[int] = None,
     **kwargs,
 ) -> Runtime:
     """Initialize this party's controller.
@@ -81,7 +86,13 @@ def init(
     - ``device_put_received``: place received array payloads onto local
       devices eagerly;
     - ``process_default``: also register this runtime as the process-wide
-      default (disable when simulating multiple parties in one process).
+      default (disable when simulating multiple parties in one process);
+    - ``coordinator_address`` + ``num_party_processes`` +
+      ``party_process_id``: this party spans several JAX processes (a
+      multi-host pod slice).  ``jax.distributed`` is initialized so the
+      party's mesh covers every host; only process 0 runs the cross-party
+      wire transport and the other processes receive pushed values through
+      the party process bridge (see :mod:`rayfed_tpu.distributed`).
     """
     assert cluster, "Cluster should be provided."
     assert party, "Party should be provided."
@@ -120,6 +131,25 @@ def init(
         wait_for_ready=enable_waiting_for_other_parties_ready,
         device_put_received=device_put_received,
     )
+    if recv_backstop_in_seconds is not None:
+        job_config.recv_backstop_s = float(recv_backstop_in_seconds)
+    if mailbox_ttl_in_seconds is not None:
+        job_config.mailbox_ttl_s = float(mailbox_ttl_in_seconds)
+
+    party_group = None
+    if coordinator_address is not None:
+        from rayfed_tpu.distributed import PartyProcessGroup
+
+        if num_party_processes is None or party_process_id is None:
+            raise ValueError(
+                "coordinator_address requires num_party_processes and "
+                "party_process_id"
+            )
+        # Must run before any JAX backend use so the global device view
+        # spans the whole party.
+        party_group = PartyProcessGroup(
+            coordinator_address, num_party_processes, party_process_id
+        )
 
     if mesh is None and mesh_shape is not None:
         from rayfed_tpu.parallel.mesh import create_mesh
@@ -142,8 +172,26 @@ def init(
     )
     runtime.cleanup_manager.start()
 
-    transport = TransportManager(cluster_config, job_config)
-    transport.start()
+    if party_group is not None:
+        from rayfed_tpu.distributed import MultiHostTransport
+
+        inner = None
+        if party_group.is_leader:
+            inner = TransportManager(cluster_config, job_config)
+            inner.start()
+        transport = MultiHostTransport(
+            inner,
+            party_group,
+            allowed=cluster_config.serializing_allowed_list,
+            device_put_received=device_put_received,
+            # Same backstop as the leader's wire recv — the party's
+            # processes must time out together or not at all (a lone
+            # non-leader failure desyncs the SPMD program).
+            timeout_s=job_config.recv_backstop_s,
+        )
+    else:
+        transport = TransportManager(cluster_config, job_config)
+        transport.start()
     runtime.send_proxy = transport
     runtime.recv_proxy = transport
     runtime.transport = transport

@@ -74,7 +74,25 @@ class FedCheckpointer:
     def _round_dir(self, round_num: int) -> str:
         return os.path.join(self._dir, f"round_{round_num:08d}")
 
+    def _recover(self) -> None:
+        """Finish an interrupted save: a ``round_N.old`` left behind by a
+        crash is promoted back to ``round_N`` if the canonical dir is
+        missing, or deleted if the canonical dir completed."""
+        for name in os.listdir(self._dir):
+            m = re.fullmatch(r"(round_\d+)\.old", name)
+            if not m:
+                continue
+            old_path = os.path.join(self._dir, name)
+            canonical = os.path.join(self._dir, m.group(1))
+            if os.path.exists(os.path.join(canonical, "meta.json")):
+                shutil.rmtree(old_path)
+            else:
+                if os.path.exists(canonical):
+                    shutil.rmtree(canonical)  # incomplete promote
+                os.replace(old_path, canonical)
+
     def rounds(self) -> list[int]:
+        self._recover()
         out = []
         for name in os.listdir(self._dir):
             m = re.fullmatch(r"round_(\d+)", name)
@@ -109,9 +127,18 @@ class FedCheckpointer:
             json.dump(
                 {"round": round_num, "party": self._party, **(metadata or {})}, f
             )
+        # Keep a complete checkpoint under SOME name at every instant: move
+        # the old round aside, promote the new one, then drop the old copy —
+        # a crash mid-sequence leaves either round_N or round_N.old intact
+        # (never only an undiscoverable .tmp).
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
         if os.path.exists(path):
-            shutil.rmtree(path)
+            os.replace(path, old)
         os.replace(tmp, path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
         self._gc()
         logger.info("[%s] checkpoint saved: round %d", self._party, round_num)
 

@@ -110,3 +110,9 @@ class JobConfig:
     wait_for_ready: bool = False
     # TPU-native: put received array payloads on local devices eagerly.
     device_put_received: bool = True
+    # Backstop deadline for a parked recv and TTL for unclaimed pushes.
+    # Deliberately generous (peer *compute* time between rounds is
+    # unbounded by the per-RPC timeout above); bounds leaked state from
+    # desynced/dead peers without gating slow-but-healthy ones.
+    recv_backstop_s: float = 3600.0
+    mailbox_ttl_s: float = 3600.0

@@ -1,0 +1,320 @@
+"""Multi-host parties: one party spanning several JAX processes.
+
+The reference's party is one Ray cluster (any number of machines behind
+one GCS); this framework's party is a JAX process group — the TPU-native
+equivalent of "a party spans hosts" is ``jax.distributed.initialize``
+over the party's pod slice (SURVEY §2.10 inter-party row).  Compute then
+runs SPMD over a global mesh spanning every host in the party, with XLA
+collectives riding ICI/DCN.
+
+Cross-party traffic stays on the push transport, but only **process 0 of
+each party (the leader)** runs it — one listener, one egress per party.
+Values a non-leader process needs (recv'd pushes, broadcast-on-get
+results) reach it through the **party process bridge**: the
+jax.distributed coordination service's key-value store, keyed by the
+same deterministic ``(upstream, downstream)`` rendezvous ids as the wire.
+The KV bridge is key-addressed and unordered, so recv futures may
+resolve in any order on any thread — no collective-ordering hazard (the
+ordered-collective alternative, ``multihost_utils.broadcast_one_to_all``,
+would require every process to resolve recvs in lockstep program order).
+
+Payload sizing: bridge values ride the coordination service (designed
+for metadata, not bulk tensors) — fine for control values, model deltas
+and CPU-test scale.  Bulk sharded arrays should instead be produced ON
+the party mesh (each process feeds its local shards) rather than pushed
+through a single leader; see ``parallel/sharding.py``.
+"""
+
+from __future__ import annotations
+
+import base64
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from rayfed_tpu.executor import LocalRef
+
+logger = logging.getLogger(__name__)
+
+_BRIDGE_PREFIX = "rayfed_bridge"
+
+
+class PartyProcessGroup:
+    """This party's JAX process group (leader = process 0).
+
+    Wraps ``jax.distributed.initialize`` plus the coordination-service
+    KV client used as the intra-party value bridge.
+    """
+
+    def __init__(
+        self,
+        coordinator_address: str,
+        num_processes: int,
+        process_id: int,
+    ) -> None:
+        import jax
+
+        self.num_processes = int(num_processes)
+        self.process_id = int(process_id)
+        self.coordinator_address = coordinator_address
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+        from jax._src import distributed as _jdist
+
+        self._client = _jdist.global_state.client
+        if self._client is None:  # pragma: no cover
+            raise RuntimeError("jax.distributed did not expose a KV client")
+        self._published: List[Tuple[str, str, float]] = []
+        self._published_lock = threading.Lock()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    # -- KV bridge ------------------------------------------------------------
+
+    def _key(self, upstream_seq_id: Any, downstream_seq_id: Any) -> str:
+        return f"{_BRIDGE_PREFIX}/{upstream_seq_id}#{downstream_seq_id}"
+
+    def _ack_key(self, upstream_seq_id, downstream_seq_id, pid: int) -> str:
+        return (
+            f"{_BRIDGE_PREFIX}_ack/{upstream_seq_id}#{downstream_seq_id}/{pid}"
+        )
+
+    def publish(self, upstream_seq_id, downstream_seq_id, data: bytes) -> None:
+        """Leader-side: make a received value visible to all party processes."""
+        self._client.key_value_set(
+            self._key(upstream_seq_id, downstream_seq_id),
+            base64.b64encode(data).decode("ascii"),
+        )
+        with self._published_lock:
+            self._published.append(
+                (str(upstream_seq_id), str(downstream_seq_id), time.monotonic())
+            )
+
+    def fetch(
+        self, upstream_seq_id, downstream_seq_id, timeout_s: float
+    ) -> bytes:
+        """Non-leader-side: block until the leader publishes the value."""
+        encoded = self._client.blocking_key_value_get(
+            self._key(upstream_seq_id, downstream_seq_id),
+            int(timeout_s * 1000),
+        )
+        # Ack so the leader's GC can delete the entry once every
+        # non-leader has read it (the coordination-service KV is for
+        # metadata — values must not accumulate for the job's lifetime).
+        try:
+            self._client.key_value_set(
+                self._ack_key(upstream_seq_id, downstream_seq_id, self.process_id),
+                "1",
+            )
+        except Exception:  # pragma: no cover
+            logger.debug("bridge ack failed", exc_info=True)
+        return base64.b64decode(encoded)
+
+    def _probe(self, key: str) -> bool:
+        try:
+            self._client.blocking_key_value_get(key, 1)
+            return True
+        except Exception:
+            return False
+
+    def gc_published(self, ttl_s: float = 3600.0) -> int:
+        """Leader-side: delete bridge entries every non-leader has acked
+        (or that exceeded the TTL).  Returns the number deleted."""
+        with self._published_lock:
+            tracked = list(self._published)
+        deleted = 0
+        now = time.monotonic()
+        keep = []
+        for up, down, t0 in tracked:
+            acked = all(
+                self._probe(self._ack_key(up, down, pid))
+                for pid in range(1, self.num_processes)
+            )
+            if acked or now - t0 > ttl_s:
+                for k in [self._key(up, down)] + [
+                    self._ack_key(up, down, pid)
+                    for pid in range(1, self.num_processes)
+                ]:
+                    try:
+                        self._client.key_value_delete(k)
+                    except Exception:  # pragma: no cover
+                        pass
+                deleted += 1
+            else:
+                keep.append((up, down, t0))
+        with self._published_lock:
+            # Re-merge entries published while GC ran.
+            fresh = [e for e in self._published if e not in tracked]
+            self._published = keep + fresh
+        return deleted
+
+    def barrier(self, name: str, timeout_s: float = 120.0) -> None:
+        self._client.wait_at_barrier(name, int(timeout_s * 1000))
+
+    def cleanup(self) -> None:
+        """Best-effort removal of bridge keys (leader, at shutdown)."""
+        if not self.is_leader:
+            return
+        try:
+            self._client.key_value_delete(_BRIDGE_PREFIX)
+        except Exception:  # pragma: no cover - older jax w/o dir delete
+            logger.debug("bridge key cleanup not supported", exc_info=True)
+
+    def shutdown(self) -> None:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # pragma: no cover
+            logger.debug("jax.distributed.shutdown failed", exc_info=True)
+
+
+def _encode_value(value: Any) -> bytes:
+    from rayfed_tpu.transport import wire
+
+    return b"".join(
+        bytes(b) if not isinstance(b, bytes) else b
+        for b in wire.encode_payload(value)
+    )
+
+
+def _decode_value(data: bytes, allowed: Optional[Dict], device_put: bool) -> Any:
+    from rayfed_tpu.transport import wire
+
+    return wire.decode_payload(data, allowed=allowed, device_put=device_put)
+
+
+class MultiHostTransport:
+    """Send/recv proxy for a party spanning multiple JAX processes.
+
+    - Leader: wraps the party's real :class:`TransportManager`; every
+      successful recv is additionally published on the process bridge.
+    - Non-leader: no wire at all — sends resolve ``True`` immediately
+      (the leader performs the real push; the same deterministic program
+      runs there), recvs fetch from the bridge.
+    """
+
+    def __init__(
+        self,
+        inner,  # TransportManager | None
+        group: PartyProcessGroup,
+        *,
+        allowed: Optional[Dict] = None,
+        device_put_received: bool = True,
+        timeout_s: float = 60.0,
+    ) -> None:
+        self._inner = inner
+        self._group = group
+        self._allowed = allowed
+        self._device_put = device_put_received
+        self._timeout_s = timeout_s
+        self._fetch_pool = (
+            None
+            if group.is_leader
+            else concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="rayfed-bridge-fetch"
+            )
+        )
+        self._gc_stop = threading.Event()
+        self._gc_thread: Optional[threading.Thread] = None
+        if group.is_leader and group.num_processes > 1:
+            def _gc_loop():
+                while not self._gc_stop.wait(15.0):
+                    try:
+                        self._group.gc_published()
+                    except Exception:  # pragma: no cover
+                        logger.debug("bridge GC error", exc_info=True)
+
+            self._gc_thread = threading.Thread(
+                target=_gc_loop, name="rayfed-bridge-gc", daemon=True
+            )
+            self._gc_thread.start()
+
+    # -- proxy interface ------------------------------------------------------
+
+    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id):
+        if self._inner is not None:
+            return self._inner.send(
+                dest_party=dest_party,
+                data=data,
+                upstream_seq_id=upstream_seq_id,
+                downstream_seq_id=downstream_seq_id,
+            )
+        # Non-leader: the leader's identical program does the real push.
+        return LocalRef.from_value(True)
+
+    def recv(self, src_party, upstream_seq_id, downstream_seq_id):
+        if self._inner is not None:
+            ref = self._inner.recv(
+                src_party=src_party,
+                upstream_seq_id=upstream_seq_id,
+                downstream_seq_id=downstream_seq_id,
+            )
+            if self._group.num_processes > 1:
+                def _publish(r: LocalRef) -> None:
+                    if r.exception() is not None:
+                        return
+                    try:
+                        self._group.publish(
+                            upstream_seq_id,
+                            downstream_seq_id,
+                            _encode_value(r.resolve()),
+                        )
+                    except Exception:
+                        logger.exception(
+                            "bridge publish failed for (%s, %s)",
+                            upstream_seq_id, downstream_seq_id,
+                        )
+
+                ref.add_done_callback(_publish)
+            return ref
+
+        out = LocalRef()
+
+        def _fetch():
+            try:
+                data = self._group.fetch(
+                    upstream_seq_id, downstream_seq_id, self._timeout_s
+                )
+                out.set_result(
+                    _decode_value(data, self._allowed, self._device_put)
+                )
+            except Exception as e:
+                out.set_exception(
+                    TimeoutError(
+                        f"bridge fetch of ({upstream_seq_id}, "
+                        f"{downstream_seq_id}) failed: {e}"
+                    )
+                )
+
+        self._fetch_pool.submit(_fetch)
+        return out
+
+    def ping(self, dest_party: str, timeout_s: float = 1.0) -> bool:
+        if self._inner is not None:
+            return self._inner.ping(dest_party, timeout_s)
+        return True  # non-leaders have no wire to check
+
+    def get_stats(self) -> Dict[str, Any]:
+        stats = self._inner.get_stats() if self._inner is not None else {}
+        stats["party_process_id"] = self._group.process_id
+        stats["party_num_processes"] = self._group.num_processes
+        return stats
+
+    def stop(self) -> None:
+        self._gc_stop.set()
+        if self._gc_thread is not None:
+            self._gc_thread.join(timeout=5)
+        if self._inner is not None:
+            self._inner.stop()
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown(wait=False)
+        self._group.cleanup()
+        self._group.shutdown()

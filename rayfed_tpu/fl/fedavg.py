@@ -51,18 +51,51 @@ def tree_average(trees: Sequence[Any], weights: Optional[Sequence[float]] = None
     return tree_weighted_sum(trees, tuple(float(w) for w in weights))
 
 
-def aggregate(fed_objects: Sequence[Any], weights: Optional[Sequence[float]] = None):
+def aggregate(
+    fed_objects: Sequence[Any],
+    weights: Optional[Sequence[float]] = None,
+    *,
+    mode: str = "auto",
+    coordinator: Optional[str] = None,
+):
     """FedAvg round: fetch every party's update and average.
 
     ``fed_objects``: one FedObject per party (each owned by its producing
     party).  Every party calls this with the same list at the same point
-    in the program — owned objects are pushed to all peers, unowned ones
-    are received — so all parties return the identical averaged tree.
+    in the program, so all parties return the identical averaged tree.
+
+    Wire topology (``mode``):
+
+    - ``"all_to_all"``: every owner pushes to every peer and each party
+      averages locally — N·(N-1) transfers.  Lowest latency at N=2.
+    - ``"coordinator"``: contributions go to one party (default: the
+      owner of ``fed_objects[0]``), which averages and broadcasts the
+      result — 2·(N-1) transfers.  The right shape for N>2.
+    - ``"auto"``: coordinator when more than two objects, else
+      all-to-all.
+
+    The choice is made from ``len(fed_objects)`` and the argument values
+    only — identical on every controller, preserving seq-id determinism.
     """
     import rayfed_tpu as fed
 
-    values = fed.get(list(fed_objects))
-    return tree_average(values, weights)
+    objs = list(fed_objects)
+    if mode == "auto":
+        mode = "coordinator" if len(objs) > 2 else "all_to_all"
+    if mode == "all_to_all":
+        values = fed.get(objs)
+        return tree_average(values, weights)
+    if mode != "coordinator":
+        raise ValueError(f"unknown aggregate mode {mode!r}")
+
+    coord = coordinator or objs[0].get_party()
+    w = None if weights is None else tuple(float(x) for x in weights)
+
+    def _avg(*trees):
+        return tree_average(trees, w)
+
+    avg_obj = fed.remote(_avg).party(coord).remote(*objs)
+    return fed.get(avg_obj)
 
 
 class FedAvgActorBase:

@@ -307,14 +307,58 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=(0, 1))
 
 
+def make_train_loop(
+    config: LlamaConfig,
+    num_steps: int,
+    lr: float = 3e-4,
+    *,
+    attn_fn: Callable = dot_product_attention,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+):
+    """N full-param Adam steps in ONE compiled program (lax.scan).
+
+    (params, opt, ids) → (params, opt, losses[num_steps]).  One dispatch
+    covers all N steps — on hosts where the accelerator sits behind a
+    high-latency link, per-call dispatch would otherwise dominate and
+    make wall-clock throughput unmeasurable.
+    """
+
+    def loss_fn(params, ids):
+        logits = apply_llama(params, ids, config, attn_fn=attn_fn)
+        return lm_loss(logits[:, :-1], ids[:, 1:])
+
+    def run(params, opt, ids):
+        def body(carry, _):
+            params, opt = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+            params, opt = _adam_update(params, grads, opt, lr, b1, b2, eps)
+            return (params, opt), loss
+
+        (params, opt), losses = jax.lax.scan(
+            body, (params, opt), None, length=num_steps
+        )
+        return params, opt, losses
+
+    return jax.jit(run, donate_argnums=(0, 1))
+
+
 def param_count(params: Params, *, exclude_embed: bool = False) -> int:
-    """Total parameter count (optionally excluding the embedding table)."""
+    """Total parameter count (optionally excluding the embedding table).
+
+    Works on real arrays or ``jax.eval_shape`` abstract values — use
+    ``param_count(jax.eval_shape(lambda: init_llama(k, cfg)))`` to count
+    without allocating.
+    """
+    import math
+
     total = 0
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         name = jax.tree_util.keystr(path)
         if exclude_embed and "embed" in name:
             continue
-        total += leaf.size
+        total += math.prod(leaf.shape)
     return total
 
 

@@ -73,6 +73,12 @@ def apply_moe(
     logits = x @ params["gate"].astype(x.dtype)  # [B, T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [B, T, k]
+    # Renormalize over the selected k (GShard/Mixtral convention) so the
+    # combine weights sum to 1 per token regardless of how much mass the
+    # softmax put outside the top-k.
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9
+    )
 
     # Position of each (token, choice) within its expert's capacity buffer.
     onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [B, T, k, E]

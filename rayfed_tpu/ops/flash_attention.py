@@ -204,6 +204,235 @@ def _flash_forward(
     return o, lse[..., 0]
 
 
+def _flash_bwd_dq_kernel(
+    q_ref,  # (1, block_q, d)
+    k_ref,  # (1, block_k, d)
+    v_ref,  # (1, block_k, d)
+    do_ref,  # (1, block_q, d)
+    lse_ref,  # (1, block_q, 128)
+    delta_ref,  # (1, block_q, 128)
+    dq_ref,  # out (1, block_q, d)
+    acc_ref,  # VMEM (block_q, d) f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    kv_offset: int,
+):
+    """dQ = (P ∘ (dO Vᵀ − D)) K · scale, accumulated over kv blocks."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    should_compute = True
+    if causal:
+        should_compute = (
+            kv_offset + ki * block_k <= q_offset + qi * block_q + block_q - 1
+        )
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # exp(s - lse); fully-masked rows have lse ~ NEG_INF — zero them.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref,  # (1, block_q, d)
+    k_ref,  # (1, block_k, d)
+    v_ref,  # (1, block_k, d)
+    do_ref,  # (1, block_q, d)
+    lse_ref,  # (1, block_q, 128)
+    delta_ref,  # (1, block_q, 128)
+    dk_ref,  # out (1, block_k, d)
+    dv_ref,  # out (1, block_k, d)
+    dk_acc_ref,  # VMEM (block_k, d) f32
+    dv_acc_ref,  # VMEM (block_k, d) f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    kv_offset: int,
+):
+    """dV = Pᵀ dO and dK = dSᵀ Q · scale, accumulated over q blocks."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    should_compute = True
+    if causal:
+        # A q block strictly before this kv block sees none of it.
+        should_compute = (
+            q_offset + qi * block_q + block_q - 1 >= kv_offset + ki * block_k
+        )
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # pᵀ @ do: (block_k, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dsᵀ @ (q·scale): scale already folded into q
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(
+    q, k, v, o, lse, do, *, scale: float, causal: bool,
+    block_q: int, block_k: int, q_offset: int, kv_offset: int, interpret: bool,
+):
+    """Pallas flash backward on [BH, T, D] inputs → (dq, dk, dv).
+
+    Two tiled kernels: dQ iterates kv blocks innermost (accumulator over
+    the q row block), dK/dV iterates q blocks innermost (accumulators
+    over the kv block).  ``delta = rowsum(dO ∘ O)`` and the saved lse are
+    lane-broadcast to 128 so their blocks satisfy TPU (8, 128) tiling.
+    """
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    if t_q % block_q or t_k % block_k:
+        raise ValueError(
+            f"block sizes ({block_q}, {block_k}) must divide the "
+            f"sequence lengths ({t_q}, {t_k})"
+        )
+    d_pad = d if interpret else ((d + 127) // 128) * 128
+    if d_pad != d:
+        pad = [(0, 0), (0, 0), (0, d_pad - d)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        o = jnp.pad(o, pad)
+        do = jnp.pad(do, pad)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # (bh, t_q)
+    lse_b = jnp.broadcast_to(lse[..., None], (bh, t_q, 128))
+    delta_b = jnp.broadcast_to(delta[..., None], (bh, t_q, 128))
+
+    common = dict(
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        q_offset=q_offset,
+        kv_offset=kv_offset,
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, t_q // block_q, t_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d_pad), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(bh, t_k // block_k, t_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_k, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d_pad), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b)
+
+    if d_pad != d:
+        dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
+    return dq, dk, dv
+
+
 def _flash_backward_blockwise(
     q, k, v, o, lse, do, *, scale: float, causal: bool, block_k: int,
     q_offset: int = 0, kv_offset: int = 0,
@@ -301,7 +530,7 @@ def _flash_bwd_bthd(
 ):
     q, k, v, out, lse = res
     b, t, h, d = q.shape
-    dq, dk, dv = _flash_backward_blockwise(
+    dq, dk, dv = _flash_backward_pallas(
         _bthd_to_bht(q),
         _bthd_to_bht(k),
         _bthd_to_bht(v),
@@ -310,9 +539,11 @@ def _flash_bwd_bthd(
         _bthd_to_bht(g),
         scale=scale,
         causal=causal,
+        block_q=block_q,
         block_k=block_k,
         q_offset=q_offset,
         kv_offset=kv_offset,
+        interpret=interpret,
     )
     return _bht_to_bthd(dq, b, h), _bht_to_bthd(dk, b, h), _bht_to_bthd(dv, b, h)
 
@@ -327,8 +558,11 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    # Defaults from an on-chip sweep (v5e, T=2048-4096, fwd+bwd): a small
+    # q tile keeps both bwd accumulators resident while a wide kv tile
+    # amortizes the per-tile loop overhead.
     block_q: int = 128,
-    block_k: int = 128,
+    block_k: int = 512,
     q_offset: int = 0,
     kv_offset: int = 0,
     mask: Optional[jax.Array] = None,
@@ -352,7 +586,24 @@ def flash_attention(
     if interpret is None:
         interpret = not _on_tpu()
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    # Blocks must divide the sequence lengths: shrink the requested size
+    # to the largest 8-aligned divisor (e.g. T=1280 with block_k=512 →
+    # 256) instead of erroring on any non-multiple length.
+    block_q = _fit_block(q.shape[1], block_q)
+    block_k = _fit_block(k.shape[1], block_k)
     return _flash_bthd(
         q, k, v, scale, causal, block_q, block_k,
         int(q_offset), int(kv_offset), interpret,
     )
+
+
+def _fit_block(t: int, want: int) -> int:
+    """Largest block <= want that divides t (8-aligned when possible)."""
+    b = min(want, t)
+    while b > 8 and (t % b or b % 8):
+        b -= 8
+    if t % b == 0:
+        return b
+    while b > 1 and t % b:  # tiny/odd sequence lengths (tests)
+        b -= 1
+    return max(b, 1)

@@ -43,8 +43,16 @@ class TransportClient:
         metadata: Optional[Dict[str, str]] = None,
         ssl_context: Optional[ssl.SSLContext] = None,
         server_hostname: Optional[str] = None,
-        checksum: bool = True,
+        checksum: Optional[bool] = None,
     ) -> None:
+        if checksum is None:
+            # Match the manager's policy: checksum only when the fast C++
+            # CRC path is built.  A directly-constructed client otherwise
+            # pays a ~MB/s pure-Python CRC on the event loop for a digest
+            # that a native-less receiver skips verifying anyway.
+            from rayfed_tpu import native
+
+            checksum = native.is_available()
         self._checksum = checksum
         self._src_party = src_party
         self._dest_party = dest_party

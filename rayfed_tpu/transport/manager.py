@@ -47,7 +47,8 @@ class TransportManager:
         self._loop_thread: Optional[threading.Thread] = None
         self._started = threading.Event()
 
-        self._mailbox = Mailbox()
+        self._mailbox = Mailbox(ttl_s=job_config.mailbox_ttl_s)
+        self._gc_task: Optional[asyncio.TimerHandle] = None
         my_cfg = cluster_config.party_config(self._party)
         listen_addr = my_cfg.listen_addr or my_cfg.address
         self._server = TransportServer(
@@ -91,6 +92,14 @@ class TransportManager:
         # (parity with ray.get(actor.is_ready.remote()), barriers.py:379).
         fut = asyncio.run_coroutine_threadsafe(self._server.start(), self._loop)
         fut.result(timeout=30)
+
+        def _periodic_gc():
+            self._mailbox.gc()
+            self._gc_task = self._loop.call_later(30.0, _periodic_gc)
+
+        self._gc_task = self._loop.call_soon_threadsafe(
+            lambda: self._loop.call_later(30.0, _periodic_gc)
+        )
 
     def stop(self) -> None:
         async def _shutdown():
@@ -256,7 +265,13 @@ class TransportManager:
         device_put = self._job.device_put_received
 
         cf = asyncio.run_coroutine_threadsafe(
-            self._mailbox.get(str(upstream_seq_id), str(downstream_seq_id)),
+            self._mailbox.get(
+                str(upstream_seq_id),
+                str(downstream_seq_id),
+                # Backstop deadline: an abandoned recv surfaces as an
+                # error instead of a parked coroutine leaking forever.
+                timeout_s=self._job.recv_backstop_s,
+            ),
             self._loop,
         )
 

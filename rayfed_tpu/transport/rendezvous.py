@@ -7,15 +7,32 @@ exists — whichever side arrives first creates the entry.  The reference
 mixes ``threading.Lock`` with asyncio inside a Ray actor (flagged as a
 wart at ``barriers.py:303``); here everything runs on a single asyncio
 loop, so no locks are needed at all.
+
+Hardening beyond the reference:
+
+- **Duplicate-delivery dedup**: a retry after a lost ACK re-delivers the
+  same (up, down) key; consumed keys are remembered (bounded LRU) and
+  re-deliveries are dropped instead of leaking a never-consumed entry.
+- **TTL garbage collection**: undelivered payloads nobody ever recvs are
+  expired after ``ttl_s`` (default: off until the manager wires it to the
+  job's timeout), bounding mailbox memory.
+- **Recv deadline**: ``get(..., timeout_s=...)`` raises ``TimeoutError``
+  instead of parking forever, so a dead peer surfaces as an error on
+  ``fed.get`` rather than a hang.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
+import time
 from typing import Any, Dict, Optional, Tuple
 
 Key = Tuple[str, str]  # (upstream_seq_id, downstream_seq_id)
+
+# How many consumed keys to remember for duplicate-delivery detection.
+_CONSUMED_CACHE = 8192
 
 
 @dataclasses.dataclass
@@ -31,11 +48,12 @@ class Message:
 
 
 class _Entry:
-    __slots__ = ("event", "message")
+    __slots__ = ("event", "message", "created_at")
 
     def __init__(self) -> None:
         self.event = asyncio.Event()
         self.message: Optional[Message] = None
+        self.created_at = time.monotonic()
 
 
 class Mailbox:
@@ -44,11 +62,25 @@ class Mailbox:
     All methods must be called from the owning asyncio loop.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, ttl_s: Optional[float] = None) -> None:
         self._entries: Dict[Key, _Entry] = {}
+        self._consumed: "collections.OrderedDict[Key, None]" = (
+            collections.OrderedDict()
+        )
+        self._ttl_s = ttl_s
+        self.stats: Dict[str, int] = {
+            "dropped_duplicates": 0,
+            "expired": 0,
+        }
 
     def put(self, message: Message) -> None:
         key = (message.upstream_seq_id, message.downstream_seq_id)
+        if key in self._consumed:
+            # Re-delivery of an already-consumed rendezvous (sender retry
+            # after a lost ACK) — dropping it prevents an entry that no
+            # recv will ever pop.
+            self.stats["dropped_duplicates"] += 1
+            return
         entry = self._entries.get(key)
         if entry is None:
             entry = _Entry()
@@ -56,17 +88,66 @@ class Mailbox:
         entry.message = message
         entry.event.set()
 
-    async def get(self, upstream_seq_id: str, downstream_seq_id: str) -> Message:
+    def _mark_consumed(self, key: Key) -> None:
+        self._consumed[key] = None
+        self._consumed.move_to_end(key)
+        while len(self._consumed) > _CONSUMED_CACHE:
+            self._consumed.popitem(last=False)
+
+    async def get(
+        self,
+        upstream_seq_id: str,
+        downstream_seq_id: str,
+        timeout_s: Optional[float] = None,
+    ) -> Message:
         key = (str(upstream_seq_id), str(downstream_seq_id))
         entry = self._entries.get(key)
         if entry is None:
             entry = _Entry()
             self._entries[key] = entry
-        await entry.event.wait()
+        try:
+            if timeout_s is None:
+                await entry.event.wait()
+            else:
+                await asyncio.wait_for(entry.event.wait(), timeout=timeout_s)
+        except asyncio.TimeoutError:
+            # Only the parked-waiter entry is discarded; a message that
+            # raced in concurrently has set the event and is returned.
+            if entry.message is None:
+                self._entries.pop(key, None)
+                raise TimeoutError(
+                    f"recv of ({key[0]}, {key[1]}) timed out after {timeout_s}s"
+                ) from None
         # Pop: a rendezvous key is consumed exactly once (ref barriers.py:338-340).
         self._entries.pop(key, None)
+        self._mark_consumed(key)
         assert entry.message is not None
         return entry.message
 
+    def gc(self, now: Optional[float] = None) -> int:
+        """Expire undelivered messages older than the TTL; returns count."""
+        if self._ttl_s is None:
+            return 0
+        now = time.monotonic() if now is None else now
+        # An entry is GC-eligible only when data arrived but nobody
+        # consumed it: a parked waiter's entry has message None (its own
+        # timeout governs), and data+waiter resolves immediately anyway.
+        expired = [
+            key
+            for key, entry in self._entries.items()
+            if entry.message is not None and now - entry.created_at > self._ttl_s
+        ]
+        for key in expired:
+            self._entries.pop(key, None)
+        self.stats["expired"] += len(expired)
+        return len(expired)
+
     def pending_count(self) -> int:
         return len(self._entries)
+
+    def pending_bytes(self) -> int:
+        return sum(
+            len(e.message.payload)
+            for e in self._entries.values()
+            if e.message is not None
+        )

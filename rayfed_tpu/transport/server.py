@@ -35,6 +35,9 @@ logger = logging.getLogger(__name__)
 _PREFIX_SIZE = wire.HEADER_SIZE
 # Payloads at or above this size get their checksum verified off-loop.
 _OFFLOAD_CRC_BYTES = 4 * 1024 * 1024
+# Headers are small JSON (ids + metadata); a corrupt or hostile peer must
+# not be able to force a multi-GB allocation via the 32-bit hlen field.
+_MAX_HEADER_BYTES = 1 * 1024 * 1024
 
 
 class _FrameProtocol(asyncio.BufferedProtocol):
@@ -109,6 +112,15 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._msg_type = msg_type
         self._hlen = hlen
         self._plen = plen
+        if hlen > _MAX_HEADER_BYTES:
+            # Can't even read a header this size to echo a request id —
+            # drop the connection before allocating anything.
+            logger.warning(
+                "[%s] header of %d bytes exceeds cap %d (peer=%s); closing",
+                self._server._party, hlen, _MAX_HEADER_BYTES, self._peer,
+            )
+            self._abort()
+            return
         if plen > self._server._max_message_size:
             # Fatal (non-retryable).  Read the header (to echo rid), reply,
             # then close — never allocate the oversized payload.

@@ -68,8 +68,23 @@ def test_flash_jit_and_shape_check():
     )
     out = jitted(q, k, v)
     assert out.shape == q.shape
-    with pytest.raises(ValueError, match="divide"):
-        flash_attention(q, k, v, block_q=13, block_k=16)
+    # Non-dividing block sizes auto-shrink to a divisor instead of
+    # raising (T=48 with block 13 → largest fitting block).
+    out2 = flash_attention(q, k, v, block_q=13, block_k=16)
+    np.testing.assert_allclose(
+        np.asarray(out2), np.asarray(out), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_block_autofit_nonmultiple_t():
+    """T=1280 is a multiple of 128 but not of the 512 default block_k —
+    must run (shrunken block), not raise (round-2 regression guard)."""
+    q, k, v = _qkv(jax.random.PRNGKey(11), t=1280, h=1)
+    out = flash_attention(q, k, v, causal=True)
+    expected = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-2, rtol=2e-2
+    )
 
 
 def test_flash_offsets_match_dense():

@@ -1,0 +1,104 @@
+"""Multi-host party: 2 JAX processes form ONE party federated with a third.
+
+The verdict-driving scenario (SURVEY §2.10 inter-party row): party
+``alice`` spans two processes (a simulated 2-host pod slice, 4 virtual
+CPU devices each → one 8-device global mesh) with only process 0 running
+the wire transport; party ``bob`` is a normal single-process party.
+Cross-party pushes land on alice's leader and reach the second alice
+process through the jax.distributed KV bridge.
+"""
+
+import multiprocessing as mp
+
+import pytest
+
+from tests.multiproc import get_free_ports
+
+
+def _run_member(role, rank, coord_port, cluster, q):
+    from rayfed_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(4)
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+
+    if role == "alice":
+        fed.init(
+            address="local",
+            cluster=cluster,
+            party="alice",
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            num_party_processes=2,
+            party_process_id=rank,
+        )
+        # The party mesh spans both processes: 8 global devices, 4 local.
+        assert len(jax.devices()) == 8, jax.devices()
+        assert jax.local_device_count() == 4
+    else:
+        fed.init(address="local", cluster=cluster, party="bob")
+
+    @fed.remote
+    def make_data():
+        return np.arange(8.0, dtype=np.float32)
+
+    @fed.remote
+    def alice_global_sum(x):
+        from jax.experimental import multihost_utils
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        # Shard the 8-element vector over the party's 8 global devices
+        # (each process feeds its 4 local shards), then a jitted global
+        # sum — a collective spanning both alice processes.
+        local = np.asarray(x).reshape(8)[
+            jax.process_index() * 4 : (jax.process_index() + 1) * 4
+        ]
+        gx = multihost_utils.host_local_array_to_global_array(
+            local, mesh, P("dp")
+        )
+        total = jax.jit(jnp.sum)(gx)
+        return float(jax.device_get(total))
+
+    data = make_data.party("bob").remote()
+    total = alice_global_sum.party("alice").remote(data)
+    out = fed.get(total)
+    assert out == pytest.approx(28.0), out
+    fed.shutdown()
+    q.put((role, rank, out))
+
+
+CLUSTER_PORTS = get_free_ports(3)
+
+
+def test_party_spanning_two_processes():
+    coord_port, alice_port, bob_port = CLUSTER_PORTS
+    cluster = {
+        "alice": {"address": f"127.0.0.1:{alice_port}"},
+        "bob": {"address": f"127.0.0.1:{bob_port}"},
+    }
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    members = [("alice", 0), ("alice", 1), ("bob", 0)]
+    procs = [
+        ctx.Process(
+            target=_run_member,
+            args=(role, rank, coord_port, cluster, q),
+            name=f"{role}-{rank}",
+        )
+        for role, rank in members
+    ]
+    for p in procs:
+        p.start()
+    results = []
+    for _ in members:
+        results.append(q.get(timeout=180))
+    for p in procs:
+        p.join(30)
+        if p.is_alive():
+            p.terminate()
+            raise AssertionError("member process hung")
+    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+    assert sorted(r[2] for r in results) == pytest.approx([28.0] * 3)
