@@ -173,22 +173,73 @@ def _run_split_party(party: str, result_q) -> None:
     x_objs = [load_x.party("alice").remote(mb) for mb in range(k_mb)]
     y_objs = [load_y.party("bob").remote(mb) for mb in range(k_mb)]
 
-    steps = 8
-    trainer.step_pipelined(x_objs, y_objs)  # warmup + compile
+    # Microbatch count: on a multi-core host the pipelined step overlaps
+    # K transfers with compute; this 1-core bench host time-slices
+    # everything, so in-flight buffers only add scheduling pressure —
+    # use the serialized step there (k_mb=1 path).
+    k_mb_eff = k_mb if os.cpu_count() and os.cpu_count() > 2 else 1
+    steps = 8 if k_mb_eff > 1 else 24
+    xs = x_objs[:k_mb_eff]
+    ys = y_objs[:k_mb_eff]
+    trainer.step_pipelined(xs, ys)  # warmup + compile
     # Barrier on the *encoder* queue: get_params is ordered after every
     # backward/apply, so warmup's reverse traffic fully drains before t0
     # and the timed window includes the last step's reverse traffic.
     fed.get(trainer.encoder_params())
     t0 = time.perf_counter()
     for _ in range(steps):
-        trainer.step_pipelined(x_objs, y_objs)
+        trainer.step_pipelined(xs, ys)
     fed.get(trainer.encoder_params())
     elapsed = time.perf_counter() - t0
     # Per step: K x (activations alice->bob + grads bob->alice), f32.
-    bytes_per_step = 2 * k_mb * n * d_hidden * 4
+    bytes_per_step = 2 * k_mb_eff * n * d_hidden * 4
     if result_q is not None:
         result_q.put((party, steps * bytes_per_step / elapsed / 1e9))
     fed.shutdown()
+
+
+def _run_push_bench(_party: str, result_q) -> None:
+    """Raw send-proxy throughput: 128MB mesh-sharded pushes on loopback.
+
+    Measures the wire path itself (shard-streamed encode → socket →
+    per-shard device_put re-shard) with no model in the loop — the
+    send-proxy GB/s capability number (BASELINE.md #5's metric).
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.transport.manager import TransportManager
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                "alice": PartyConfig.from_dict({"address": "127.0.0.1:13050"}),
+                "bob": PartyConfig.from_dict({"address": "127.0.0.1:13051"}),
+            },
+            current_party=party,
+        )
+        return TransportManager(cc, JobConfig(device_put_received=True))
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    a, b = mk("alice"), mk("bob")
+    b.mesh_provider = lambda: mesh
+    a.start()
+    b.start()
+    x = jnp.arange(32 * 1024 * 1024, dtype=jnp.float32).reshape(8192, 4096)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    a.send("bob", xs, "warm", "0")
+    b.recv("alice", "warm", "0").resolve()
+    steps = 6
+    t0 = time.perf_counter()
+    for i in range(steps):
+        a.send("bob", xs, f"p{i}", "0")
+        b.recv("alice", f"p{i}", "0").resolve()
+    dt = time.perf_counter() - t0
+    a.stop()
+    b.stop()
+    result_q.put(("push", x.nbytes * steps / dt / 1e9))
 
 
 def _party_child(fn_name: str, party: str, result_q) -> None:
@@ -197,6 +248,20 @@ def _party_child(fn_name: str, party: str, result_q) -> None:
 
     force_cpu_devices(8)
     globals()[fn_name](party, result_q)
+
+
+def _one_child(fn_name: str) -> float:
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    proc = ctx.Process(target=_party_child, args=(fn_name, "solo", q))
+    proc.start()
+    try:
+        _name, value = q.get(timeout=300)
+    finally:
+        proc.join(30)
+        if proc.is_alive():
+            proc.terminate()
+    return value
 
 
 def _two_party(fn_name: str) -> float:
@@ -406,6 +471,11 @@ def main() -> None:
         _log(f"  flash: {extra}")
 
     if not compute_only:
+        _log("raw send-proxy push throughput (128MB sharded, loopback)...")
+        push = _one_child("_run_push_bench")
+        extra["push_GBps"] = round(push, 3)
+        _log(f"  push: {push:.3f} GB/s")
+
         _log("split-FL activation push (CPU parties, real transport)...")
         gbps = _two_party("_run_split_party")
         extra["split_fl_GBps"] = round(gbps, 3)

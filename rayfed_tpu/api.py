@@ -178,6 +178,7 @@ def init(
         inner = None
         if party_group.is_leader:
             inner = TransportManager(cluster_config, job_config)
+            inner.mesh_provider = lambda: runtime.mesh
             inner.start()
         transport = MultiHostTransport(
             inner,
@@ -191,6 +192,7 @@ def init(
         )
     else:
         transport = TransportManager(cluster_config, job_config)
+        transport.mesh_provider = lambda: runtime.mesh
         transport.start()
     runtime.send_proxy = transport
     runtime.recv_proxy = transport

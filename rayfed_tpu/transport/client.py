@@ -141,7 +141,8 @@ class TransportClient:
     # -- RPCs -----------------------------------------------------------------
 
     async def _roundtrip(
-        self, msg_type: int, header: Dict[str, Any], payload_bufs: List
+        self, msg_type: int, header: Dict[str, Any], payload_bufs: List,
+        crc_trailer: bool = False,
     ) -> Dict[str, Any]:
         await self._ensure_connected()
         rid = next(self._rid)
@@ -150,14 +151,15 @@ class TransportClient:
         fut: asyncio.Future = loop.create_future()
         self._pending[rid] = fut
         payload_len = wire.payload_nbytes(payload_bufs)
+        flags = wire.FLAG_CRC_TRAILER if crc_trailer else 0
         try:
             async with self._write_lock:
                 assert self._writer is not None
                 for buf in wire.pack_frame(msg_type, header,
-                                           payload_len=payload_len):
+                                           payload_len=payload_len,
+                                           flags=flags):
                     self._writer.write(buf)
-                for buf in payload_bufs:
-                    self._writer.write(buf)
+                await self._write_payload(loop, payload_bufs, crc_trailer)
                 await self._writer.drain()
             return await asyncio.wait_for(fut, timeout=self._timeout_s)
         except SendError:
@@ -175,6 +177,41 @@ class TransportClient:
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)
             raise
+
+    async def _write_payload(
+        self, loop, payload_bufs: List, crc_trailer: bool
+    ) -> None:
+        """Write payload buffers, producing lazy shards with one-ahead
+        prefetch: shard k+1's device→host fetch runs in the executor while
+        shard k drains to the socket.  With ``crc_trailer``, the checksum
+        chains across buffers off-loop and lands in a 4-byte trailer."""
+
+        if crc_trailer:
+            from rayfed_tpu import native
+
+        def _materialize(buf, seed):
+            host = buf.produce() if isinstance(buf, wire.LazyBuffer) else buf
+            # Fetch + checksum in ONE executor hop; the chained seed makes
+            # the trailer equal crc32c(concat(payload)).
+            crc = native.crc32c(host, seed) if crc_trailer else 0
+            return host, crc
+
+        if not payload_bufs:
+            return
+        crc = 0
+        prefetch = loop.run_in_executor(None, _materialize, payload_bufs[0], 0)
+        for i in range(len(payload_bufs)):
+            host, crc = await prefetch
+            if i + 1 < len(payload_bufs):
+                prefetch = loop.run_in_executor(
+                    None, _materialize, payload_bufs[i + 1], crc
+                )
+            self._writer.write(host)
+            await self._writer.drain()
+        if crc_trailer:
+            import struct
+
+            self._writer.write(struct.pack(">I", crc))
 
     @property
     def checksum_enabled(self) -> bool:
@@ -204,7 +241,13 @@ class TransportClient:
             "down": str(downstream_seq_id),
             "meta": merged_meta,
         }
-        if crc is None and self._checksum:
+        has_lazy = any(isinstance(b, wire.LazyBuffer) for b in payload_bufs)
+        crc_trailer = False
+        if has_lazy:
+            # Streamed payload: the checksum chains incrementally during
+            # the write and rides a trailer, not the header.
+            crc_trailer = self._checksum
+        elif crc is None and self._checksum:
             # Prefer passing ``crc`` precomputed off-loop (the manager's
             # codec pool does) — this inline path serves direct callers.
             from rayfed_tpu import native
@@ -221,7 +264,9 @@ class TransportClient:
                 backoff = min(backoff * policy.backoff_multiplier,
                               policy.max_backoff_s)
             try:
-                ack = await self._roundtrip(wire.MSG_DATA, header, payload_bufs)
+                ack = await self._roundtrip(
+                    wire.MSG_DATA, header, payload_bufs, crc_trailer=crc_trailer
+                )
                 return ack.get("result", "OK")
             except FatalSendError:
                 raise

@@ -68,6 +68,10 @@ class TransportManager:
             "send_bytes": 0,
             "send_seconds": 0.0,
         }
+        # Set by api.init: () -> Optional[jax.sharding.Mesh].  Received
+        # shard-encoded leaves whose sender sharding fits this mesh are
+        # device_put with the equivalent local NamedSharding.
+        self.mesh_provider = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -191,13 +195,18 @@ class TransportManager:
 
         def _encode_and_send(value: Any) -> None:
             try:
-                bufs = wire.encode_payload(value)
+                bufs = wire.encode_payload(value, lazy_shards=True)
                 nbytes = wire.payload_nbytes(bufs)
                 t0 = time.perf_counter()
                 client = self._get_client(dest_party)
                 crc = None
-                if client.checksum_enabled:
+                streaming = any(
+                    isinstance(b, wire.LazyBuffer) for b in bufs
+                )
+                if client.checksum_enabled and not streaming:
                     # Checksum on the codec thread, not the event loop.
+                    # (Streamed payloads checksum incrementally during
+                    # the write — see TransportClient._write_payload.)
                     from rayfed_tpu import native
 
                     crc = native.crc32c_multi(bufs)
@@ -284,8 +293,12 @@ class TransportManager:
 
             def _decode():
                 try:
+                    mesh = self.mesh_provider() if self.mesh_provider else None
                     value = wire.decode_payload(
-                        message.payload, allowed=allowed, device_put=device_put
+                        message.payload,
+                        allowed=allowed,
+                        device_put=device_put,
+                        mesh=mesh,
                     )
                     from rayfed_tpu import metrics
 

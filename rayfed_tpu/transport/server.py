@@ -51,14 +51,16 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._small_view = memoryview(self._small)
         self._need = _PREFIX_SIZE
         self._got = 0
-        self._state = "prefix"  # prefix | header | payload
+        self._state = "prefix"  # prefix | header | payload | trailer
         self._msg_type = 0
+        self._flags = 0
         self._hlen = 0
         self._plen = 0
         self._header: Dict[str, Any] = {}
         self._payload: Optional[bytearray] = None
         self._payload_view: Optional[memoryview] = None
         self._payload_t0 = 0.0
+        self._trailer_crc: Optional[int] = None
         self._peer = None
         self._closed = False
 
@@ -87,6 +89,8 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 self._on_prefix()
             elif self._state == "header":
                 self._on_header()
+            elif self._state == "trailer":
+                self._on_trailer()
             else:
                 self._on_payload()
         except Exception:
@@ -106,10 +110,11 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             self._small_view = memoryview(self._small)
 
     def _on_prefix(self) -> None:
-        msg_type, _flags, hlen, plen = wire.unpack_frame_prefix(
+        msg_type, flags, hlen, plen = wire.unpack_frame_prefix(
             bytes(self._small_view[:_PREFIX_SIZE])
         )
         self._msg_type = msg_type
+        self._flags = flags
         self._hlen = hlen
         self._plen = plen
         if hlen > _MAX_HEADER_BYTES:
@@ -144,7 +149,10 @@ class _FrameProtocol(asyncio.BufferedProtocol):
     def _begin_payload(self) -> None:
         if self._plen == 0:
             self._payload = bytearray(0)
-            self._dispatch_frame()
+            if self._flags & wire.FLAG_CRC_TRAILER:
+                self._expect("trailer", 4)
+            else:
+                self._dispatch_frame()
             return
         self._payload = bytearray(self._plen)
         self._payload_view = memoryview(self._payload)
@@ -152,6 +160,15 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._expect("payload", self._plen)
 
     def _on_payload(self) -> None:
+        if self._flags & wire.FLAG_CRC_TRAILER:
+            self._expect("trailer", 4)
+            return
+        self._dispatch_frame()
+
+    def _on_trailer(self) -> None:
+        import struct
+
+        (self._trailer_crc,) = struct.unpack(">I", bytes(self._small_view[:4]))
         self._dispatch_frame()
 
     def _reset(self) -> None:
@@ -198,6 +215,10 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         read_seconds = (
             (time.perf_counter() - self._payload_t0) if self._payload_t0 else 0.0
         )
+        trailer_crc = self._trailer_crc
+        self._trailer_crc = None
+        if trailer_crc is not None and "crc" not in header:
+            header = dict(header, crc=trailer_crc)
         self._reset()
 
         if msg_type == wire.MSG_PING:

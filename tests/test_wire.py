@@ -111,3 +111,73 @@ def test_scalar_and_noncontiguous_arrays_roundtrip():
         out = _roundtrip(x)
         assert out.shape == x.shape, (x.shape, out.shape)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+# -- sharded (lazy) encoding: SURVEY §7 stage 5 ------------------------------
+
+
+def _mesh2():
+    import numpy as _np
+
+    devs = jax.devices()[:2]
+    return jax.sharding.Mesh(_np.array(devs), ("dp",))
+
+
+def test_sharded_encode_roundtrip_host():
+    """A 2-device-sharded array round-trips shard-wise (host decode)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh2()
+    x = jnp.arange(4 * 1024 * 1024, dtype=jnp.float32).reshape(2048, 2048)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    bufs = wire.encode_payload({"w": xs}, lazy_shards=True)
+    assert any(isinstance(b, wire.LazyBuffer) for b in bufs), "expected lazy shards"
+    payload = b"".join(
+        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
+        for b in bufs
+    )
+    out = wire.decode_payload(payload)
+    np.testing.assert_array_equal(out["w"], np.asarray(x))
+
+
+def test_sharded_decode_resharded_on_mesh():
+    """Receiver with a matching mesh gets the leaf re-sharded, not replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh2()
+    x = jnp.ones((2048, 2048), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    bufs = wire.encode_payload(xs, lazy_shards=True)
+    payload = b"".join(
+        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
+        for b in bufs
+    )
+    out = wire.decode_payload(payload, device_put=True, mesh=mesh)
+    assert isinstance(out, jax.Array)
+    assert isinstance(out.sharding, NamedSharding)
+    assert out.sharding.spec == P("dp", None) or tuple(out.sharding.spec) == ("dp", None)
+    assert len({s.device for s in out.addressable_shards}) == 2
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_sharded_decode_without_mesh_falls_back():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh2()
+    xs = jax.device_put(
+        jnp.zeros((2048, 2048), jnp.float32), NamedSharding(mesh, P("dp"))
+    )
+    bufs = wire.encode_payload(xs, lazy_shards=True)
+    payload = b"".join(
+        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
+        for b in bufs
+    )
+    out = wire.decode_payload(payload, device_put=True)  # no mesh
+    assert isinstance(out, jax.Array)
+    assert out.shape == (2048, 2048)
+
+
+def test_small_arrays_stay_eager():
+    x = jnp.ones((8, 8))
+    bufs = wire.encode_payload({"x": x}, lazy_shards=True)
+    assert not any(isinstance(b, wire.LazyBuffer) for b in bufs)
