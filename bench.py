@@ -387,30 +387,26 @@ def bench_llama() -> dict:
 
 
 def bench_flash() -> dict:
-    """Flash (pallas) vs dense attention, fwd+bwd, causal, T=2048.
+    """Flash (pallas) vs dense attention, fwd+bwd, causal, T=2048 + 4096.
 
-    Same slope-timing discipline as :func:`bench_llama`: N chained
-    fwd+bwd iterations inside one jitted ``lax.scan``, per-iter time
-    from the slope between short and long runs.
+    Same slope-timing discipline as :func:`bench_llama`, but with a
+    60-iteration scan delta (28 at T=4096, where per-iter times are ~2×
+    longer) and median-of-3: the tunnel's per-dispatch round trip is
+    ~100 ms of noise, so short deltas (the round-2 bench used 10
+    iterations) can swing the slope by several ms per iter.
     """
     import jax.numpy as jnp
 
     from rayfed_tpu.ops.attention import dot_product_attention
     from rayfed_tpu.ops.flash_attention import flash_attention
 
-    b, t, h, dh = 4, 2048, 16, 64
-    keys = jax.random.split(jax.random.PRNGKey(0), 3)
-    q0, k0, v0 = (
-        jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16) for kk in keys
-    )
-
-    def timed(fn) -> float:
+    def timed(fn, q0, k0, v0, n_short=4, n_long=64) -> float:
         def loss(q, k, v):
             return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
 
         grad_fn = jax.grad(loss, argnums=(0, 1, 2))
 
-        def chain(n):
+        def build(n):
             @jax.jit
             def run(q, k, v):
                 def body(carry, _):
@@ -424,32 +420,60 @@ def bench_flash() -> dict:
 
             out = run(q0, k0, v0)  # compile + warm
             float(jax.device_get(jnp.sum(out.astype(jnp.float32))))
+            return run
+
+        def once(run):
             t0 = time.perf_counter()
             out = run(q0, k0, v0)
             float(jax.device_get(jnp.sum(out.astype(jnp.float32))))
             return time.perf_counter() - t0
 
-        n_short, n_long = 2, 12
-        return max((chain(n_long) - chain(n_short)) / (n_long - n_short), 1e-9)
+        run_s, run_l = build(n_short), build(n_long)
+        slopes = sorted(
+            (once(run_l) - once(run_s)) / (n_long - n_short) for _ in range(3)
+        )
+        return max(slopes[1], 1e-9)
 
-    _log("  compiling flash/dense attention chains...")
-    dense_t = timed(dot_product_attention)
-    flash_t = timed(flash_attention)
+    def shape(b, t):
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        return [
+            jax.random.normal(kk, (b, t, 16, 64), jnp.bfloat16) for kk in keys
+        ]
+
+    _log("  compiling flash/dense attention chains (T=2048)...")
+    args = shape(4, 2048)
+    dense_t = timed(dot_product_attention, *args)
+    flash_t = timed(flash_attention, *args)
+    _log("  compiling flash/dense attention chains (T=4096)...")
+    # Half batch at 4096 so dense's [B,H,T,T] f32 score tensor fits.
+    args4k = shape(2, 4096)
+    dense4k = timed(dot_product_attention, *args4k, n_long=32)
+    flash4k = timed(flash_attention, *args4k, n_long=32)
     return {
         "flash_speedup": round(dense_t / flash_t, 3),
         "flash_ms": round(flash_t * 1e3, 2),
         "dense_ms": round(dense_t * 1e3, 2),
+        "flash_speedup_t4096": round(dense4k / flash4k, 3),
+        "flash_ms_t4096": round(flash4k * 1e3, 2),
+        "dense_ms_t4096": round(dense4k * 1e3, 2),
     }
 
 
 def _prior_baseline(metric: str):
+    """Earliest recorded value of ``metric`` across driver BENCH files.
+
+    The driver nests the JSON line this script prints under a ``parsed``
+    key; accept both that and a bare record (hand-run copies).
+    """
     values = []
     for path in sorted(glob.glob(os.path.join(os.path.dirname(__file__), "BENCH_r*.json"))):
         try:
             with open(path) as f:
                 rec = json.load(f)
-            if rec.get("metric") == metric and rec.get("value"):
-                values.append(float(rec["value"]))
+            for r in (rec.get("parsed") or {}, rec):
+                if r.get("metric") == metric and r.get("value"):
+                    values.append(float(r["value"]))
+                    break
         except Exception:
             continue
     return values[0] if values else None

@@ -9,9 +9,9 @@ dimension — TPU grids execute sequentially, so scratch persists across
 the kv loop.  Matches `rayfed_tpu.ops.attention.dot_product_attention`
 numerically (same recurrence as ``blockwise_accumulate``).
 
-Backward is a memory-efficient blockwise recompute in plain JAX (scan
-over kv blocks, O(T·block) live memory) using the saved per-row
-log-sum-exp — the standard flash-attention backward formulation.
+Backward is two tiled pallas kernels (dQ and dK/dV) that recompute the
+score tile from the saved per-row log-sum-exp — the standard
+flash-attention backward formulation, O(T·block) live memory.
 
 Runs in interpret mode off-TPU (auto-detected), so the CPU test mesh
 exercises the same code path.
@@ -80,12 +80,15 @@ def _flash_fwd_kernel(
 
     @pl.when(should_compute)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # Feed the MXU native-dtype (bf16) operands — casting to f32 first
+        # would force f32 matmul passes at a fraction of bf16 throughput.
+        # Accumulation is f32 via preferred_element_type.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
+        ) * scale  # (block_q, block_k) f32
         if causal:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -108,7 +111,8 @@ def _flash_fwd_kernel(
         )
         l_cur = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         acc_ref[...] = acc_ref[...] * correction + pv
         m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
@@ -139,11 +143,11 @@ def _flash_forward(
 ):
     """Run the pallas kernel on [BH, T, D] inputs; returns (o, lse).
 
-    On the compiled TPU path the head dim is zero-padded to a multiple of
-    128 (MXU lane width) — zeros in the contracting dim don't change
-    q·kᵀ, and padded v columns produce padded output columns we slice
-    off.  The lse output is lane-broadcast to (bh, t_q, 128) so its block
-    satisfies the TPU (8, 128) tiling rule, then lane 0 is taken.
+    The head dim is used directly as the block lane dim — Mosaic pads
+    sub-128 tiles internally, which beats explicitly zero-padding to 128
+    (that would double HBM traffic and MXU passes for d=64).  The lse
+    output is lane-broadcast to (bh, t_q, 128) so its block satisfies
+    the TPU (8, 128) tiling rule, then lane 0 is taken.
     """
     bh, t_q, d = q.shape
     t_k = k.shape[1]
@@ -159,12 +163,6 @@ def _flash_forward(
             f"TPU tiling requires block sizes divisible by 8, got "
             f"({block_q}, {block_k})"
         )
-    d_pad = d if interpret else ((d + 127) // 128) * 128
-    if d_pad != d:
-        pad = [(0, 0), (0, 0), (0, d_pad - d)]
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
     grid = (bh, t_q // block_q, t_k // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel,
@@ -176,7 +174,7 @@ def _flash_forward(
         kv_offset=kv_offset,
     )
     scratch = [
-        pltpu.VMEM((block_q, d_pad), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
         pltpu.VMEM((block_q, 128), jnp.float32),
         pltpu.VMEM((block_q, 128), jnp.float32),
     ]
@@ -184,23 +182,21 @@ def _flash_forward(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_q, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t_q, 128), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
-    if d_pad != d:
-        o = o[..., :d]
     return o, lse[..., 0]
 
 
@@ -238,15 +234,16 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(should_compute)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype (bf16) MXU operands, f32 accumulation — see fwd.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        ) * scale
         if causal:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -262,7 +259,8 @@ def _flash_bwd_dq_kernel(
         )
         ds = p * (dp - delta)
         acc_ref[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == num_k - 1)
@@ -308,15 +306,16 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(should_compute)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype (bf16) MXU operands, f32 accumulation — see fwd.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
+        ) * scale  # (block_q, block_k)
         if causal:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -327,19 +326,21 @@ def _flash_bwd_dkv_kernel(
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # pᵀ @ do: (block_k, d)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
         dk_acc_ref[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # dsᵀ @ (q·scale): scale already folded into q
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dsᵀ @ q (un-normalized; scale applied at finalize)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
-        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
@@ -363,14 +364,6 @@ def _flash_backward_pallas(
             f"block sizes ({block_q}, {block_k}) must divide the "
             f"sequence lengths ({t_q}, {t_k})"
         )
-    d_pad = d if interpret else ((d + 127) // 128) * 128
-    if d_pad != d:
-        pad = [(0, 0), (0, 0), (0, d_pad - d)]
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
-        o = jnp.pad(o, pad)
-        do = jnp.pad(do, pad)
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # (bh, t_q)
@@ -389,16 +382,16 @@ def _flash_backward_pallas(
         functools.partial(_flash_bwd_dq_kernel, **common),
         grid=(bh, t_q // block_q, t_k // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d_pad), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
 
@@ -406,81 +399,29 @@ def _flash_backward_pallas(
         functools.partial(_flash_bwd_dkv_kernel, **common),
         grid=(bh, t_k // block_k, t_q // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_k, d_pad), k.dtype),
-            jax.ShapeDtypeStruct((bh, t_k, d_pad), v.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d_pad), jnp.float32),
-            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
 
-    if d_pad != d:
-        dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
     return dq, dk, dv
-
-
-def _flash_backward_blockwise(
-    q, k, v, o, lse, do, *, scale: float, causal: bool, block_k: int,
-    q_offset: int = 0, kv_offset: int = 0,
-):
-    """Blockwise flash backward in plain JAX ([BH, T, D] layout, f32).
-
-    Standard formulation: with P = exp(S - lse) and D = rowsum(dO ∘ O),
-    dV = Pᵀ dO, dS = P ∘ (dO Vᵀ − D), dQ = dS K·scale, dK = dSᵀ Q·scale.
-    Scans over kv blocks so only one [T_q, block_k] score tile is live.
-    """
-    bh, t_q, d = q.shape
-    t_k = k.shape[1]
-    block_k = min(block_k, t_k)
-    num_blocks = t_k // block_k
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32).reshape(bh, num_blocks, block_k, d)
-    vf = v.astype(jnp.float32).reshape(bh, num_blocks, block_k, d)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (bh, t_q)
-    q_pos = q_offset + jnp.arange(t_q)
-
-    def body(dq_acc, blk):
-        k_blk, v_blk, j = blk  # (bh, block_k, d), index
-        s = jnp.einsum("bqd,bkd->bqk", qf * scale, k_blk)
-        if causal:
-            k_pos = kv_offset + j * block_k + jnp.arange(block_k)
-            s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s, NEG_INF)
-        # Masked entries must contribute 0 — for fully-masked rows lse is
-        # ~NEG_INF too, and exp(s - lse) would be exp(0) = 1.
-        p = jnp.where(
-            s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[..., None])
-        )  # (bh, t_q, block_k)
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk)
-        ds = p * (dp - delta[..., None])
-        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_blk) * scale
-        dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
-        return dq_acc, (dk, dv)
-
-    dq0 = jnp.zeros((bh, t_q, d), jnp.float32)
-    dq, (dk, dv) = jax.lax.scan(
-        body,
-        dq0,
-        (kf.transpose(1, 0, 2, 3), vf.transpose(1, 0, 2, 3), jnp.arange(num_blocks)),
-    )
-    dk = dk.transpose(1, 0, 2, 3).reshape(bh, t_k, d)
-    dv = dv.transpose(1, 0, 2, 3).reshape(bh, t_k, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(
@@ -558,11 +499,13 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    # Defaults from an on-chip sweep (v5e, T=2048-4096, fwd+bwd): a small
-    # q tile keeps both bwd accumulators resident while a wide kv tile
-    # amortizes the per-tile loop overhead.
-    block_q: int = 128,
-    block_k: int = 512,
+    # Defaults from an on-chip sweep (v5e, b=4 T=2048 h=16 dh=64 bf16,
+    # fwd+bwd, min-of-3 over a 60-iter scan delta): 1024/1024 = 4.0 ms vs
+    # 512/1024 = 4.3, 512/512 = 5.1, 128/512 = 8.8, dense = 15.6.  Large
+    # tiles amortize per-step overhead; bigger (1024/2048) exceeds the
+    # 16 MB scoped-VMEM limit in the dkv kernel.
+    block_q: int = 1024,
+    block_k: int = 1024,
     q_offset: int = 0,
     kv_offset: int = 0,
     mask: Optional[jax.Array] = None,

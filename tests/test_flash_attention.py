@@ -77,7 +77,7 @@ def test_flash_jit_and_shape_check():
 
 
 def test_flash_block_autofit_nonmultiple_t():
-    """T=1280 is a multiple of 128 but not of the 512 default block_k —
+    """T=1280 is a multiple of 128 but not of the 1024 default blocks —
     must run (shrunken block), not raise (round-2 regression guard)."""
     q, k, v = _qkv(jax.random.PRNGKey(11), t=1280, h=1)
     out = flash_attention(q, k, v, causal=True)
