@@ -47,6 +47,12 @@ class LlamaConfig:
     max_seq_len: int = 8192
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # Storage dtype of the params.  float32 master weights are the
+    # default; bfloat16 halves the param+grad HBM footprint (what lets a
+    # ~1B-param model + Adam fit a single 16 GB v5e chip) at the cost of
+    # rounding away updates below ~0.2% of a weight's magnitude.  Adam
+    # moments stay float32 either way (see init_adam).
+    param_dtype: Any = jnp.float32
     remat: bool = False
 
     @property
@@ -82,24 +88,28 @@ def init_llama(key: jax.Array, config: LlamaConfig) -> Params:
     L = config.num_layers
     k_embed, k_layers, k_head = jax.random.split(key, 3)
 
+    pdt = config.param_dtype
+
     def dense(key, *shape, fan_in):
-        return (jax.random.normal(key, shape) * fan_in**-0.5).astype(jnp.float32)
+        return (jax.random.normal(key, shape) * fan_in**-0.5).astype(pdt)
 
     lk = jax.random.split(k_layers, 7)
     params: Params = {
-        "embed": dense(k_embed, config.vocab_size, d, fan_in=1.0) * 0.02 * d**0.5,
+        "embed": (
+            jax.random.normal(k_embed, (config.vocab_size, d)) * 0.02 * d**0.5
+        ).astype(pdt),
         "layers": {
-            "attn_norm": jnp.ones((L, d)),
+            "attn_norm": jnp.ones((L, d), pdt),
             "wq": dense(lk[0], L, d, h * dh, fan_in=d),
             "wk": dense(lk[1], L, d, kv * dh, fan_in=d),
             "wv": dense(lk[2], L, d, kv * dh, fan_in=d),
             "wo": dense(lk[3], L, h * dh, d, fan_in=h * dh),
-            "mlp_norm": jnp.ones((L, d)),
+            "mlp_norm": jnp.ones((L, d), pdt),
             "w_gate": dense(lk[4], L, d, f, fan_in=d),
             "w_up": dense(lk[5], L, d, f, fan_in=d),
             "w_down": dense(lk[6], L, f, d, fan_in=f),
         },
-        "final_norm": jnp.ones((d,)),
+        "final_norm": jnp.ones((d,), pdt),
     }
     if not config.tie_embeddings:
         params["lm_head"] = dense(k_head, d, config.vocab_size, fan_in=d)
@@ -210,7 +220,15 @@ def apply_llama(
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    # bf16 MXU operands, f32 accumulation — a pure-f32 lm_head matmul
+    # runs at a fraction of bf16 throughput and the f32 accumulator
+    # already carries the precision the loss needs.
+    logits = jax.lax.dot_general(
+        x.astype(dtype),
+        head.astype(dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     return logits
 
 
@@ -235,15 +253,34 @@ PARTITION_RULES = (
 
 
 def _adam_update(params, grads, opt, lr, b1, b2, eps):
+    """Adam step; arithmetic in float32 regardless of the storage dtype
+    (params/moments may be bfloat16 — see ``LlamaConfig.param_dtype``)."""
     count, m, v = opt
     count = count + 1
-    m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
-    v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, grads)
+    f32 = jnp.float32
+    m = jax.tree_util.tree_map(
+        lambda m_, g: (b1 * m_.astype(f32) + (1 - b1) * g.astype(f32)).astype(
+            m_.dtype
+        ),
+        m,
+        grads,
+    )
+    v = jax.tree_util.tree_map(
+        lambda v_, g: (
+            b2 * v_.astype(f32) + (1 - b2) * g.astype(f32) ** 2
+        ).astype(v_.dtype),
+        v,
+        grads,
+    )
     mhat_scale = 1.0 / (1 - b1**count)
     vhat_scale = 1.0 / (1 - b2**count)
     params = jax.tree_util.tree_map(
-        lambda p, m_, v_: p
-        - lr * (m_ * mhat_scale) / (jnp.sqrt(v_ * vhat_scale) + eps),
+        lambda p, m_, v_: (
+            p.astype(f32)
+            - lr
+            * (m_.astype(f32) * mhat_scale)
+            / (jnp.sqrt(v_.astype(f32) * vhat_scale) + eps)
+        ).astype(p.dtype),
         params,
         m,
         v,
@@ -363,5 +400,17 @@ def param_count(params: Params, *, exclude_embed: bool = False) -> int:
 
 
 def init_adam(params: Params):
+    """Adam state (step, m, v).
+
+    ``v`` is float32 regardless of the param storage dtype: with
+    b2=0.999 the 0.1% per-step EMA change is under half a bf16 ulp, so a
+    bfloat16 second moment could grow but never decay (the cast back
+    rounds to the unchanged value).  ``m`` follows the param dtype — its
+    b1=0.9 EMA moves ~10% per step, far above bf16 rounding, and keeping
+    it narrow is part of fitting 1B params + Adam on one 16 GB chip.
+    """
     zeros = functools.partial(jax.tree_util.tree_map, jnp.zeros_like)
-    return (jnp.zeros((), jnp.int32), zeros(params), zeros(params))
+    zeros32 = functools.partial(
+        jax.tree_util.tree_map, lambda p: jnp.zeros(p.shape, jnp.float32)
+    )
+    return (jnp.zeros((), jnp.int32), zeros(params), zeros32(params))
