@@ -242,6 +242,81 @@ def _run_push_bench(_party: str, result_q) -> None:
     result_q.put(("push", x.nbytes * steps / dt / 1e9))
 
 
+RESNET_PARTIES = ("alice", "bob", "carol", "dave")
+RESNET_CLUSTER = {
+    p: {"address": f"127.0.0.1:{13060 + i}"} for i, p in enumerate(RESNET_PARTIES)
+}
+
+
+def _run_resnet_party(party: str, result_q) -> None:
+    """BASELINE.md #3: 4-party ResNet-18 FedAvg over the real transport.
+
+    Coordinator-mode aggregation (auto at N=4): 3 pushes in + 3
+    broadcasts out per round.  Party compute stays on the host CPU (same
+    placement policy as the other federated configs); the recorded
+    numbers are rounds/s and the cross-party GB/s actually moved.
+    """
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import aggregate
+    from rayfed_tpu.models import resnet
+
+    logging.disable(logging.WARNING)
+    fed.init(address="local", cluster=RESNET_CLUSTER, party=party)
+
+    cfg = resnet.resnet18(num_classes=10)
+    n, hw = 32, 32  # CIFAR-10-shaped synthetic shard per party
+
+    # Same trainer shape as tests/test_fl_resnet.py (full ResNet-18 and
+    # one local step here; tiny config there) — change them together.
+    @fed.remote
+    class Trainer:
+        def __init__(self, seed: int):
+            key = jax.random.PRNGKey(seed)
+            self._x = jax.random.normal(key, (n, hw, hw, 3))
+            probe = jax.random.normal(jax.random.PRNGKey(0), (3, cfg.num_classes))
+            self._y = jnp.argmax(jnp.mean(self._x, axis=(1, 2)) @ probe, axis=-1)
+            self._step = resnet.make_train_step(cfg, lr=0.05)
+
+        def train(self, bundle):
+            params, state = bundle
+            opt = resnet.init_opt_state(params)
+            params, state, _opt, loss = self._step(params, state, opt, self._x, self._y)
+            jax.block_until_ready(loss)
+            return params, state
+
+    trainers = {
+        p: Trainer.party(p).remote(i + 1) for i, p in enumerate(RESNET_PARTIES)
+    }
+    bundle = resnet.init_resnet(jax.random.PRNGKey(0), cfg)
+    bundle_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(bundle)
+    )
+
+    def do_round(bundle):
+        return aggregate([trainers[p].train.remote(bundle) for p in RESNET_PARTIES])
+
+    bundle = do_round(bundle)  # warmup: compiles + first full exchange
+    jax.block_until_ready(jax.tree_util.tree_leaves(bundle)[0])
+
+    rounds = 3
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        bundle = do_round(bundle)
+    jax.block_until_ready(jax.tree_util.tree_leaves(bundle)[0])
+    elapsed = time.perf_counter() - t0
+
+    # Coordinator topology: (N-1) contributions in + (N-1) results out.
+    wire_bytes = 2 * (len(RESNET_PARTIES) - 1) * bundle_bytes * rounds
+    if result_q is not None:
+        result_q.put((party, (rounds / elapsed, wire_bytes / elapsed / 1e9)))
+    fed.shutdown()
+
+
 def _party_child(fn_name: str, party: str, result_q) -> None:
     """Spawn-process entry: pin JAX to a virtual CPU mesh before backend init."""
     from rayfed_tpu.utils import force_cpu_devices
@@ -264,18 +339,17 @@ def _one_child(fn_name: str) -> float:
     return value
 
 
-def _two_party(fn_name: str) -> float:
+def _multi_party(fn_name: str, parties=("alice", "bob"), timeout=900) -> dict:
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [
-        ctx.Process(target=_party_child, args=(fn_name, p, q))
-        for p in ("alice", "bob")
+        ctx.Process(target=_party_child, args=(fn_name, p, q)) for p in parties
     ]
     for p in procs:
         p.start()
     results = {}
-    deadline = time.time() + 600
-    while len(results) < 2 and time.time() < deadline:
+    deadline = time.time() + timeout
+    while len(results) < len(parties) and time.time() < deadline:
         try:
             party, value = q.get(timeout=5)
             results[party] = value
@@ -286,8 +360,13 @@ def _two_party(fn_name: str) -> float:
         p.join(30)
         if p.is_alive():
             p.terminate()
-    if len(results) < 2:
+    if len(results) < len(parties):
         raise RuntimeError(f"benchmark failed; partial results: {results}")
+    return results
+
+
+def _two_party(fn_name: str) -> float:
+    results = _multi_party(fn_name)
     return sum(results.values()) / len(results)
 
 
@@ -324,9 +403,9 @@ def bench_llama() -> dict:
     measurement (and ``block_until_ready`` does not sync through it;
     ``device_get`` of the final loss does).
 
-    bf16 params + Adam moments (f32 arithmetic inside the update) and
-    scan-layer remat are what fit 1B params of model+optimizer state on
-    one 16 GB v5e chip at seq 2048.
+    bf16 params + first moment (second moment f32, arithmetic f32 inside
+    the update) and scan-layer remat are what fit 1B params of
+    model+optimizer state on one 16 GB v5e chip at seq 2048.
     """
     import jax.numpy as jnp
 
@@ -510,6 +589,14 @@ def main() -> None:
         gbps = _two_party("_run_split_party")
         extra["split_fl_GBps"] = round(gbps, 3)
         _log(f"  split: {gbps:.3f} GB/s")
+
+        _log("4-party ResNet-18 FedAvg (CPU parties, real transport)...")
+        res = _multi_party("_run_resnet_party", RESNET_PARTIES)
+        rps = sum(v[0] for v in res.values()) / len(res)
+        xgbps = sum(v[1] for v in res.values()) / len(res)
+        extra["resnet_4party_rounds_per_sec"] = round(rps, 3)
+        extra["cross_party_GBps"] = round(xgbps, 3)
+        _log(f"  resnet: {rps:.3f} rounds/s, {xgbps:.3f} GB/s cross-party")
 
         metric = "fedavg_mnist_2party_rounds_per_sec"
         _log("2-party FedAvg (CPU parties, real transport)...")

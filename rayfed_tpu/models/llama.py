@@ -50,8 +50,9 @@ class LlamaConfig:
     # Storage dtype of the params.  float32 master weights are the
     # default; bfloat16 halves the param+grad HBM footprint (what lets a
     # ~1B-param model + Adam fit a single 16 GB v5e chip) at the cost of
-    # rounding away updates below ~0.2% of a weight's magnitude.  Adam
-    # moments stay float32 either way (see init_adam).
+    # rounding away updates below ~0.2% of a weight's magnitude.  Adam's
+    # first moment follows this dtype; the second moment is always
+    # float32 (see init_adam for why).
     param_dtype: Any = jnp.float32
     remat: bool = False
 
@@ -296,12 +297,17 @@ def make_lora_train_step(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    donate: bool = False,
 ):
     """Adam train step over **LoRA params only** (base weights frozen).
 
     Signature: (lora, opt, base_params, ids) → (lora, opt, loss); the
     next-token targets are ``ids`` shifted left.  ``opt`` = (step, m, v)
     from :func:`init_adam`.
+
+    ``donate`` is opt-in: in a federated fine-tune the incoming adapters
+    are also serialized for cross-party pushes, and donation would
+    delete those buffers out from under the transport.
     """
 
     def loss_fn(lora, base_params, ids):
@@ -313,7 +319,7 @@ def make_lora_train_step(
         lora, opt = _adam_update(lora, grads, opt, lr, b1, b2, eps)
         return lora, opt, loss
 
-    return jax.jit(step_fn, donate_argnums=(0, 1))
+    return jax.jit(step_fn, donate_argnums=(0, 1) if donate else ())
 
 
 def make_train_step(

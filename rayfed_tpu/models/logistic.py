@@ -61,8 +61,15 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
 
 
-def make_train_step(apply_fn, lr: float = 0.1):
-    """Fused SGD train step: (params, x, y) -> (params, loss)."""
+def make_train_step(apply_fn, lr: float = 0.1, *, donate: bool = False):
+    """Fused SGD train step: (params, x, y) -> (params, loss).
+
+    ``donate`` is opt-in: in a federated flow the incoming params are
+    usually also being serialized for cross-party pushes (the same value
+    goes to every party's trainer), and donation would delete those
+    buffers out from under the transport.  Donate only when the caller
+    owns the params exclusively (single-party training loops).
+    """
 
     def loss_fn(params, x, y):
         return softmax_cross_entropy(apply_fn(params, x), y)
@@ -72,4 +79,4 @@ def make_train_step(apply_fn, lr: float = 0.1):
         params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
         return params, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -193,8 +193,20 @@ PARTITION_RULES = (
 )
 
 
-def make_train_step(config: ResNetConfig, lr: float = 0.1, momentum: float = 0.9):
-    """SGD-with-momentum train step: (params, state, opt, x, y) → (...)."""
+def make_train_step(
+    config: ResNetConfig,
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    *,
+    donate: bool = False,
+):
+    """SGD-with-momentum train step: (params, state, opt, x, y) → (...).
+
+    ``donate`` is opt-in: in a FedAvg flow the incoming params/state are
+    also serialized for cross-party pushes, and donation would delete
+    those buffers out from under the transport.  Donate only in
+    single-owner training loops.
+    """
     from rayfed_tpu.models.logistic import softmax_cross_entropy
 
     def loss_fn(params, state, x, y):
@@ -213,7 +225,7 @@ def make_train_step(config: ResNetConfig, lr: float = 0.1, momentum: float = 0.9
         )
         return new_params, new_state, new_opt, loss
 
-    return jax.jit(step, donate_argnums=(0, 1, 2))
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
 def init_opt_state(params: Params) -> Params:
