@@ -317,6 +317,80 @@ def _run_resnet_party(party: str, result_q) -> None:
     fed.shutdown()
 
 
+def _run_lora_party(party: str, result_q) -> None:
+    """BASELINE.md #4: 2-party cross-silo Llama-LoRA federated fine-tune.
+
+    Parties train adapters on a frozen base locally and FedAvg the
+    adapters each round (all-to-all at N=2: 2 pushes/round).  Records
+    rounds/s and the adapter payload per push (2x that crosses the wire
+    each round).  Same trainer shape as tests/test_fl_lora.py (bigger
+    model here) — change them together.
+    """
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import aggregate
+    from rayfed_tpu.models import llama, lora
+
+    logging.disable(logging.WARNING)
+    fed.init(address="local", cluster=CLUSTER, party=party)
+
+    cfg = llama.LlamaConfig(
+        vocab_size=2048,
+        hidden_size=256,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=4,
+        intermediate_size=1024,
+        max_seq_len=256,
+        dtype=jnp.float32,
+    )
+    lcfg = lora.LoraConfig(rank=8, targets=(r"w[qv]$", r"lm_head$"))
+    seq, batch = 128, 4
+
+    @fed.remote
+    class Tuner:
+        def __init__(self, seed: int):
+            self._base = llama.init_llama(jax.random.PRNGKey(42), cfg)
+            self._ids = jax.random.randint(
+                jax.random.PRNGKey(seed), (batch, seq), 0, cfg.vocab_size
+            )
+            self._step = llama.make_lora_train_step(cfg, lr=1e-3)
+
+        def train(self, adapters):
+            opt = llama.init_adam(adapters)
+            adapters, opt, loss = self._step(adapters, opt, self._base, self._ids)
+            jax.block_until_ready(loss)
+            return adapters
+
+    tuners = {p: Tuner.party(p).remote(i + 10) for i, p in enumerate(("alice", "bob"))}
+    base = llama.init_llama(jax.random.PRNGKey(42), cfg)
+    adapters = lora.init_lora(jax.random.PRNGKey(7), base, lcfg)
+    adapter_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(adapters)
+    )
+
+    def do_round(adapters):
+        return aggregate([tuners[p].train.remote(adapters) for p in ("alice", "bob")])
+
+    adapters = do_round(adapters)  # warmup: compiles + first exchange
+    jax.block_until_ready(jax.tree_util.tree_leaves(adapters)[0])
+
+    rounds = 5
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        adapters = do_round(adapters)
+    jax.block_until_ready(jax.tree_util.tree_leaves(adapters)[0])
+    elapsed = time.perf_counter() - t0
+
+    if result_q is not None:
+        result_q.put((party, (rounds / elapsed, adapter_bytes / 1e6)))
+    fed.shutdown()
+
+
 def _party_child(fn_name: str, party: str, result_q) -> None:
     """Spawn-process entry: pin JAX to a virtual CPU mesh before backend init."""
     from rayfed_tpu.utils import force_cpu_devices
@@ -354,7 +428,11 @@ def _multi_party(fn_name: str, parties=("alice", "bob"), timeout=900) -> dict:
             party, value = q.get(timeout=5)
             results[party] = value
         except Exception:
+            # Fail fast: a crashed child (nonzero exit) or all children
+            # gone with results still missing means no full set is coming.
             if any(p.exitcode not in (None, 0) for p in procs):
+                break
+            if all(p.exitcode is not None for p in procs) and q.empty():
                 break
     for p in procs:
         p.join(30)
@@ -589,6 +667,14 @@ def main() -> None:
         gbps = _two_party("_run_split_party")
         extra["split_fl_GBps"] = round(gbps, 3)
         _log(f"  split: {gbps:.3f} GB/s")
+
+        _log("2-party Llama-LoRA federated fine-tune (CPU parties)...")
+        lres = _multi_party("_run_lora_party")
+        lrps = sum(v[0] for v in lres.values()) / len(lres)
+        adapter_mb = next(iter(lres.values()))[1]
+        extra["lora_2party_rounds_per_sec"] = round(lrps, 3)
+        extra["lora_adapter_MB_per_push"] = round(adapter_mb, 3)
+        _log(f"  lora: {lrps:.3f} rounds/s, {adapter_mb:.3f} MB adapters/push")
 
         _log("4-party ResNet-18 FedAvg (CPU parties, real transport)...")
         res = _multi_party("_run_resnet_party", RESNET_PARTIES)

@@ -87,14 +87,16 @@ def run_resnet_fedavg(party, cluster=RESNET_CLUSTER):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
-    # Weighted coordinator aggregation (example-count weighting).
-    weighted = aggregate(
-        [trainers[p].train.remote(bundle) for p in PARTIES],
-        weights=[1.0, 2.0, 3.0, 4.0],
-    )
-    assert jax.tree_util.tree_structure(weighted) == jax.tree_util.tree_structure(
-        bundle
-    )
+    # Weighted coordinator aggregation (example-count weighting) must
+    # match the local weighted average of the same contributions.
+    w = [1.0, 2.0, 3.0, 4.0]
+    updates = [trainers[p].train.remote(bundle) for p in PARTIES]
+    weighted = aggregate(updates, weights=w)
+    local_w = tree_average(fed.get(updates), weights=w)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(weighted), jax.tree_util.tree_leaves(local_w)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
     fed.shutdown()
 
 
