@@ -110,6 +110,11 @@ class JobConfig:
     wait_for_ready: bool = False
     # TPU-native: put received array payloads on local devices eagerly.
     device_put_received: bool = True
+    # With device_put_received=False, decode shard-streamed leaves as
+    # READONLY views aliasing the wire buffer when their layout allows
+    # (no assembly copy).  Opt-in: consumers that mutate received host
+    # arrays in place need the default writable copies.
+    zero_copy_host_arrays: bool = False
     # Backstop deadline for a parked recv and TTL for unclaimed pushes.
     # Deliberately generous (peer *compute* time between rounds is
     # unbounded by the per-RPC timeout above); bounds leaked state from

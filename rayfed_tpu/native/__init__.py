@@ -6,6 +6,10 @@ missing (g++, ~1s) and exposes:
 - :func:`crc32c` — CRC32-C checksum (slicing-by-8 in C++, GIL released)
 - :func:`gather_copy` — assemble many buffers into one ``bytearray``,
   optionally computing the checksum in the same pass
+- :func:`writev_full` — vectored socket write (writev + EAGAIN poll)
+  with the GIL released: the send path drains multi-MB payloads to the
+  kernel without copying into asyncio's transport buffer or blocking
+  the event loop
 - :func:`is_available` — False when no toolchain; every consumer keeps a
   pure-Python fallback (the transport works without native code, just
   slower on multi-MB payloads).
@@ -83,6 +87,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        lib.rf_writev_full.restype = ctypes.c_int64
+        lib.rf_writev_full.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+            ctypes.c_int,
+        ]
         _lib = lib
         return lib
 
@@ -106,20 +118,24 @@ def _byte_view(buf) -> memoryview:
 
 
 def _addr_of(mv: memoryview, keepalive: List) -> int:
-    """Address of a memoryview's first byte without copying when possible."""
+    """Address of a memoryview's first byte, zero-copy.
+
+    Writable views go through ``ctypes.from_buffer``; readonly views
+    (numpy views of jax arrays, ``bytes``) are wrapped by
+    ``np.frombuffer`` — numpy accepts readonly buffers zero-copy and
+    exposes the base address.  (An earlier version fell back to
+    ``bytes(mv)`` here, which silently memcpy'd every readonly payload —
+    at wire rates that one line halved push throughput.)
+    """
     if not mv.readonly:
         c = (ctypes.c_char * mv.nbytes).from_buffer(mv)
         keepalive.append(c)
         return ctypes.addressof(c)
-    obj = mv.obj
-    if isinstance(obj, bytes) and mv.nbytes == len(obj):
-        cp = ctypes.c_char_p(obj)  # points into the bytes' own buffer
-        keepalive.append((obj, cp))
-        return ctypes.cast(cp, ctypes.c_void_p).value
-    b = bytes(mv)  # readonly non-bytes view: one copy
-    cp = ctypes.c_char_p(b)
-    keepalive.append((b, cp))
-    return ctypes.cast(cp, ctypes.c_void_p).value
+    import numpy as np
+
+    arr = np.frombuffer(mv, dtype=np.uint8)
+    keepalive.append(arr)
+    return arr.ctypes.data
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +181,41 @@ def _crc32c_py(data, seed: int = 0) -> int:
     for b in bytes(data):
         crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
     return (~crc) & 0xFFFFFFFF
+
+
+def writev_full(fd: int, buffers: Sequence, timeout_ms: int = 60_000) -> int:
+    """Drain ``buffers`` to ``fd`` via C++ writev (GIL released).
+
+    Handles partial writes and non-blocking sockets (EAGAIN → poll for
+    writability, up to ``timeout_ms`` per stall).  Raises ``OSError`` on
+    failure.  Callers must serialize writes per fd themselves (the
+    transport client holds its per-connection write lock).
+    """
+    lib = _load()
+    views = [_byte_view(b) for b in buffers]
+    views = [mv for mv in views if mv.nbytes]
+    if not views:
+        return 0
+    if lib is None:
+        # Fallback: sequential sendall-style loop via os.write.
+        total = 0
+        for mv in views:
+            off = 0
+            while off < mv.nbytes:
+                off += os.write(fd, mv[off:])
+            total += mv.nbytes
+        return total
+    n = len(views)
+    src_arr = (ctypes.c_void_p * n)()
+    len_arr = (ctypes.c_uint64 * n)()
+    keepalive: List = []
+    for i, mv in enumerate(views):
+        src_arr[i] = _addr_of(mv, keepalive)
+        len_arr[i] = mv.nbytes
+    res = int(lib.rf_writev_full(fd, src_arr, len_arr, n, timeout_ms))
+    if res < 0:
+        raise OSError(-res, os.strerror(-res))
+    return res
 
 
 def gather_copy(buffers: Sequence, with_crc: bool = False):

@@ -1,11 +1,19 @@
-"""Transport client — persistent, multiplexed, retrying connection per peer.
+"""Transport client — pooled, multiplexed, retrying connections per peer.
 
 Plays the role of the reference's ``send_data_grpc`` channel
 (``barriers.py:121-181``) plus its gRPC service-config retry policy
 (``grpc_options.py:17-23``): attempts with exponential backoff on
 transport unavailability, a per-RPC deadline, per-party metadata headers,
-and a message-size cap.  One connection per destination party carries
-pipelined DATA frames; ACKs are matched by request id.
+and a message-size cap.
+
+Data plane: a small pool of connections per destination (concurrent
+pushes to the same party ride different sockets instead of queuing behind
+one write lock — no per-peer head-of-line blocking), and payload bytes
+go to the kernel through the native vectored-write path
+(``native.writev_full``: C++ writev with the GIL released) off the event
+loop — no copy into asyncio's transport buffer.  TLS connections fall
+back to the asyncio writer (the SSL layer owns the socket).  ACKs are
+matched by request id on each connection's reader task.
 """
 
 from __future__ import annotations
@@ -15,6 +23,7 @@ import itertools
 import json
 import logging
 import ssl
+import struct
 from typing import Any, Dict, List, Optional
 
 from rayfed_tpu.config import RetryPolicy
@@ -31,6 +40,31 @@ class FatalSendError(SendError):
     """A send rejected by the peer for a non-transient reason — not retried."""
 
 
+class _Conn:
+    """One pooled connection: socket, reader task, in-flight futures."""
+
+    __slots__ = (
+        "reader", "writer", "reader_task", "pending", "write_lock", "fd", "dead"
+    )
+
+    def __init__(self, reader, writer, fd: Optional[int]) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.fd = fd  # raw-writev path; None on TLS (SSL owns the socket)
+        self.reader_task: Optional[asyncio.Task] = None
+        self.pending: Dict[int, asyncio.Future] = {}
+        self.write_lock = asyncio.Lock()
+        self.dead = False  # teardown requested; close deferred past writes
+
+    @property
+    def busy(self) -> int:
+        return len(self.pending) + (1 if self.write_lock.locked() else 0)
+
+    @property
+    def closed(self) -> bool:
+        return self.dead or self.writer is None or self.writer.is_closing()
+
+
 class TransportClient:
     def __init__(
         self,
@@ -44,6 +78,7 @@ class TransportClient:
         ssl_context: Optional[ssl.SSLContext] = None,
         server_hostname: Optional[str] = None,
         checksum: Optional[bool] = None,
+        pool_size: int = 2,
     ) -> None:
         if checksum is None:
             # Match the manager's policy: checksum only when the fast C++
@@ -66,42 +101,60 @@ class TransportClient:
         self._ssl_context = ssl_context
         self._server_hostname = server_hostname
         self._rid = itertools.count(1)
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._reader_task: Optional[asyncio.Task] = None
-        self._pending: Dict[int, asyncio.Future] = {}
+        self._conns: List[_Conn] = []
         self._conn_lock = asyncio.Lock()
-        self._write_lock = asyncio.Lock()
+        self._pool_size = max(1, int(pool_size))
 
     # -- connection management ------------------------------------------------
 
-    async def _ensure_connected(self) -> None:
-        if self._writer is not None and not self._writer.is_closing():
-            return
-        async with self._conn_lock:
-            if self._writer is not None and not self._writer.is_closing():
-                return
-            reader, writer = await asyncio.open_connection(
-                self._host,
-                self._port,
-                ssl=self._ssl_context,
-                server_hostname=self._server_hostname if self._ssl_context else None,
-                limit=2**20,
-            )
-            self._reader = reader
-            self._writer = writer
-            self._reader_task = asyncio.ensure_future(self._read_responses(reader))
+    async def _open_conn(self) -> _Conn:
+        reader, writer = await asyncio.open_connection(
+            self._host,
+            self._port,
+            ssl=self._ssl_context,
+            server_hostname=self._server_hostname if self._ssl_context else None,
+            limit=2**20,
+        )
+        fd: Optional[int] = None
+        if self._ssl_context is None:
+            from rayfed_tpu import native
 
-    async def _read_responses(self, reader: asyncio.StreamReader) -> None:
+            if native.is_available():
+                sock = writer.get_extra_info("socket")
+                if sock is not None:
+                    fd = sock.fileno()
+        conn = _Conn(reader, writer, fd)
+        conn.reader_task = asyncio.ensure_future(self._read_responses(conn))
+        return conn
+
+    async def _acquire_conn(self) -> _Conn:
+        """Pick the least-busy live connection; grow the pool under load."""
+        self._conns = [c for c in self._conns if not c.closed]
+        if self._conns:
+            conn = min(self._conns, key=lambda c: c.busy)
+            if conn.busy == 0 or len(self._conns) >= self._pool_size:
+                return conn
+        async with self._conn_lock:
+            self._conns = [c for c in self._conns if not c.closed]
+            idle = [c for c in self._conns if c.busy == 0]
+            if idle:
+                return idle[0]
+            if len(self._conns) < self._pool_size or not self._conns:
+                conn = await self._open_conn()
+                self._conns.append(conn)
+                return conn
+            return min(self._conns, key=lambda c: c.busy)
+
+    async def _read_responses(self, conn: _Conn) -> None:
         try:
             while True:
-                prefix = await reader.readexactly(wire.HEADER_SIZE)
+                prefix = await conn.reader.readexactly(wire.HEADER_SIZE)
                 msg_type, _flags, hlen, plen = wire.unpack_frame_prefix(prefix)
-                header = json.loads(await reader.readexactly(hlen)) if hlen else {}
+                header = json.loads(await conn.reader.readexactly(hlen)) if hlen else {}
                 if plen:
-                    await reader.readexactly(plen)
+                    await conn.reader.readexactly(plen)
                 rid = header.get("rid")
-                fut = self._pending.pop(rid, None)
+                fut = conn.pending.pop(rid, None)
                 if fut is None or fut.done():
                     continue
                 if msg_type == wire.MSG_ERR:
@@ -110,82 +163,134 @@ class TransportClient:
                 else:
                     fut.set_result(header)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError) as e:
-            self._fail_pending(SendError(f"connection to {self._dest_party} lost: {e}"))
+            self._teardown(conn, SendError(f"connection to {self._dest_party} lost: {e}"))
         except asyncio.CancelledError:
-            self._fail_pending(SendError("client shutting down"))
+            self._teardown(conn, SendError("client shutting down"))
             raise
 
-    def _fail_pending(self, exc: Exception) -> None:
-        pending, self._pending = self._pending, {}
+    def _teardown(self, conn: _Conn, exc: Exception) -> None:
+        """Retire one connection and fail its in-flight requests.
+
+        The actual socket close is deferred while a write holds the lock:
+        closing mid-``writev`` would free the fd under an executor thread,
+        and a recycled fd number could splice this payload into an
+        unrelated connection.  The write path closes on exit when it sees
+        ``dead``.
+        """
+        conn.dead = True
+        pending, conn.pending = conn.pending, {}
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(exc)
-        if self._writer is not None:
+        if conn in self._conns:
+            self._conns.remove(conn)
+        if not conn.write_lock.locked():
+            self._really_close(conn)
+
+    def _really_close(self, conn: _Conn) -> None:
+        if conn.writer is not None:
             try:
-                self._writer.close()
+                conn.writer.close()
             except Exception:
                 pass
-        self._writer = None
-        self._reader = None
+        conn.writer = None
+        conn.reader = None
+        conn.fd = None
 
     async def close(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-            try:
-                await self._reader_task
-            except (asyncio.CancelledError, Exception):
-                pass
-            self._reader_task = None
-        self._fail_pending(SendError("client closed"))
+        for conn in list(self._conns):
+            if conn.reader_task is not None:
+                conn.reader_task.cancel()
+                try:
+                    await conn.reader_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                conn.reader_task = None
+            self._teardown(conn, SendError("client closed"))
+        self._conns = []
 
     # -- RPCs -----------------------------------------------------------------
 
     async def _roundtrip(
         self, msg_type: int, header: Dict[str, Any], payload_bufs: List,
-        crc_trailer: bool = False,
+        crc_trailer: bool = False, timeout_s: Optional[float] = None,
     ) -> Dict[str, Any]:
-        await self._ensure_connected()
+        conn = await self._acquire_conn()
         rid = next(self._rid)
         header = dict(header, rid=rid)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending[rid] = fut
+        conn.pending[rid] = fut
         payload_len = wire.payload_nbytes(payload_bufs)
         flags = wire.FLAG_CRC_TRAILER if crc_trailer else 0
         try:
-            async with self._write_lock:
-                assert self._writer is not None
-                for buf in wire.pack_frame(msg_type, header,
-                                           payload_len=payload_len,
-                                           flags=flags):
-                    self._writer.write(buf)
-                await self._write_payload(loop, payload_bufs, crc_trailer)
-                await self._writer.drain()
-            return await asyncio.wait_for(fut, timeout=self._timeout_s)
+            async with conn.write_lock:
+                try:
+                    if conn.closed:
+                        raise SendError(
+                            f"connection to {self._dest_party} closed"
+                        )
+                    frame_bufs = wire.pack_frame(
+                        msg_type, header, payload_len=payload_len, flags=flags
+                    )
+                    await self._write_frame(
+                        loop, conn, frame_bufs, payload_bufs, crc_trailer
+                    )
+                except (SendError, ConnectionError, OSError,
+                        asyncio.IncompleteReadError):
+                    raise  # classified by the outer arms
+                except BaseException as e:
+                    # Any other failure mid-write (a device→host fetch
+                    # raising inside LazyBuffer.produce, cancellation)
+                    # leaves the stream desynced: the frame prefix
+                    # already declared payload_len, so the NEXT frame's
+                    # bytes would be consumed as this one's payload.
+                    # The connection is unrecoverable — tear it down.
+                    # (Scoped to the write: cancellation while awaiting
+                    # the ACK below leaves a healthy stream.)
+                    self._teardown(
+                        conn,
+                        SendError(
+                            f"payload write to {self._dest_party} failed: {e}"
+                        ),
+                    )
+                    raise
+                finally:
+                    if conn.dead:
+                        self._really_close(conn)
+            return await asyncio.wait_for(
+                fut, timeout=self._timeout_s if timeout_s is None else timeout_s
+            )
+        except asyncio.TimeoutError:
+            # Deadline on the ACK.  Must precede the connection-failure
+            # arm: since 3.10 TimeoutError IS an OSError subclass, and a
+            # deadline must not tear down a healthy pooled connection
+            # (or get retried — the policy says deadlines aren't).
+            raise
         except SendError:
             # App-level MSG_ERR reply for THIS request (e.g. checksum
             # mismatch, oversize).  The connection itself is healthy —
             # don't tear it down or fail the other pipelined sends.
             # (SendError subclasses ConnectionError, so this arm must
             # precede the connection-failure arm.)
-            self._pending.pop(rid, None)
             raise
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
-            self._pending.pop(rid, None)
-            self._fail_pending(SendError(str(e)))
+            self._teardown(conn, SendError(str(e)))
             raise SendError(str(e)) from e
-        except asyncio.TimeoutError:
-            self._pending.pop(rid, None)
-            raise
+        finally:
+            conn.pending.pop(rid, None)
 
-    async def _write_payload(
-        self, loop, payload_bufs: List, crc_trailer: bool
+    async def _write_frame(
+        self, loop, conn: _Conn, frame_bufs: List, payload_bufs: List,
+        crc_trailer: bool,
     ) -> None:
-        """Write payload buffers, producing lazy shards with one-ahead
-        prefetch: shard k+1's device→host fetch runs in the executor while
-        shard k drains to the socket.  With ``crc_trailer``, the checksum
-        chains across buffers off-loop and lands in a 4-byte trailer."""
+        """Write one frame (prefix+header+payload[+crc trailer]).
 
+        Native path (non-TLS, C++ built): bytes go straight to the kernel
+        via ``writev`` in an executor thread — the event loop never
+        copies or blocks, and lazy shards overlap their device→host fetch
+        with the previous chunk's socket write.  Fallback: asyncio writer.
+        """
         if crc_trailer:
             from rayfed_tpu import native
 
@@ -196,9 +301,37 @@ class TransportClient:
             crc = native.crc32c(host, seed) if crc_trailer else 0
             return host, crc
 
+        use_fd = conn.fd is not None
+        if use_fd:
+            from rayfed_tpu import native as _native
+
+            timeout_ms = max(1000, int(self._timeout_s * 1000))
+            fd = conn.fd  # capture: teardown may null it under our feet
+
+            def _writev(bufs):
+                try:
+                    _native.writev_full(fd, bufs, timeout_ms=timeout_ms)
+                except TimeoutError as e:
+                    # A stalled fd mid-frame desyncs the stream; surface
+                    # as a connection failure (teardown), NOT a deadline
+                    # (OSError(ETIMEDOUT) auto-subclasses TimeoutError,
+                    # which the roundtrip treats as a healthy-conn ACK
+                    # deadline).
+                    raise ConnectionResetError(
+                        f"write to {self._dest_party} stalled: {e}"
+                    ) from e
+
         if not payload_bufs:
+            if use_fd:
+                await loop.run_in_executor(None, _writev, frame_bufs)
+            else:
+                for buf in frame_bufs:
+                    conn.writer.write(buf)
+                await conn.writer.drain()
             return
+
         crc = 0
+        head: List = list(frame_bufs)  # rides along with the first chunk
         prefetch = loop.run_in_executor(None, _materialize, payload_bufs[0], 0)
         for i in range(len(payload_bufs)):
             host, crc = await prefetch
@@ -206,12 +339,16 @@ class TransportClient:
                 prefetch = loop.run_in_executor(
                     None, _materialize, payload_bufs[i + 1], crc
                 )
-            self._writer.write(host)
-            await self._writer.drain()
-        if crc_trailer:
-            import struct
-
-            self._writer.write(struct.pack(">I", crc))
+            chunk = head + [host]
+            head = []
+            if i == len(payload_bufs) - 1 and crc_trailer:
+                chunk.append(struct.pack(">I", crc))
+            if use_fd:
+                await loop.run_in_executor(None, _writev, chunk)
+            else:
+                for buf in chunk:
+                    conn.writer.write(buf)
+                await conn.writer.drain()
 
     @property
     def checksum_enabled(self) -> bool:
@@ -270,6 +407,15 @@ class TransportClient:
                 return ack.get("result", "OK")
             except FatalSendError:
                 raise
+            except asyncio.TimeoutError as e:
+                # Deadline exceeded is not retried (parity: only UNAVAILABLE
+                # is a retryable status in the reference policy).  Must
+                # precede the retry arm: TimeoutError subclasses OSError
+                # since 3.10.
+                raise SendError(
+                    f"send to {self._dest_party} timed out after "
+                    f"{self._timeout_s}s"
+                ) from e
             except (SendError, OSError, ConnectionError) as e:
                 last_exc = e
                 logger.debug(
@@ -277,26 +423,18 @@ class TransportClient:
                     self._src_party, self._dest_party, attempt + 1,
                     policy.max_attempts, e,
                 )
-            except asyncio.TimeoutError as e:
-                # Deadline exceeded is not retried (parity: only UNAVAILABLE
-                # is a retryable status in the reference policy).
-                raise SendError(
-                    f"send to {self._dest_party} timed out after "
-                    f"{self._timeout_s}s"
-                ) from e
         raise SendError(
             f"send to {self._dest_party} failed after "
             f"{policy.max_attempts} attempts: {last_exc}"
         )
 
     async def ping(self, timeout_s: float = 1.0) -> bool:
+        """Readiness probe with a per-request deadline (no shared-state
+        mutation — concurrent sends keep their own timeout)."""
         try:
-            saved = self._timeout_s
-            self._timeout_s = timeout_s
-            try:
-                await self._roundtrip(wire.MSG_PING, {"src": self._src_party}, [])
-            finally:
-                self._timeout_s = saved
+            await self._roundtrip(
+                wire.MSG_PING, {"src": self._src_party}, [], timeout_s=timeout_s
+            )
             return True
         except Exception:
             return False

@@ -141,6 +141,9 @@ class TransportManager:
             # Python CRC is ~MB/s and would stall large pushes.  Explicit
             # per-party {"checksum": True} still forces it.
             "checksum": native.is_available(),
+            # Connections per destination: concurrent pushes to one party
+            # ride different sockets (no head-of-line blocking).
+            "connections_per_peer": 2,
         }
         party_opts = dict(self._cluster.party_config(dest_party).transport_options)
         # Accept reference-style gRPC channel-arg keys for drop-in compat.
@@ -171,6 +174,7 @@ class TransportManager:
                     metadata=self.merged_metadata(dest_party),
                     ssl_context=tls_utils.client_ssl_context(self._cluster.tls_config),
                     checksum=bool(opts.get("checksum", True)),
+                    pool_size=int(opts.get("connections_per_peer", 2)),
                 )
                 self._clients[dest_party] = client
             return client
@@ -299,6 +303,7 @@ class TransportManager:
                         allowed=allowed,
                         device_put=device_put,
                         mesh=mesh,
+                        zero_copy=self._job.zero_copy_host_arrays,
                     )
                     from rayfed_tpu import metrics
 

@@ -35,6 +35,11 @@ logger = logging.getLogger(__name__)
 _PREFIX_SIZE = wire.HEADER_SIZE
 # Payloads at or above this size get their checksum verified off-loop.
 _OFFLOAD_CRC_BYTES = 4 * 1024 * 1024
+# Payloads at or above this size are read off-loop: the protocol pauses
+# and a blocking recv_into loop in an executor thread drains the socket
+# straight into the preallocated payload buffer — no per-chunk event-loop
+# callbacks for the bulk bytes (mirrors the client's writev send path).
+_RAW_READ_BYTES = 4 * 1024 * 1024
 # Headers are small JSON (ids + metadata); a corrupt or hostile peer must
 # not be able to force a multi-GB allocation via the 32-bit hlen field.
 _MAX_HEADER_BYTES = 1 * 1024 * 1024
@@ -157,7 +162,77 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._payload = bytearray(self._plen)
         self._payload_view = memoryview(self._payload)
         self._payload_t0 = 0.0
+        if self._plen >= _RAW_READ_BYTES:
+            sock = (
+                None
+                if self._server._ssl_context is not None
+                else self._transport.get_extra_info("socket")
+            )
+            if sock is not None:
+                # Off-loop bulk read.  Safe w.r.t. buffering: get_buffer
+                # windows are exact, so at this point the transport holds
+                # no payload bytes — they're all still in the kernel.
+                self._transport.pause_reading()
+                self._payload_t0 = time.perf_counter()
+                loop = asyncio.get_running_loop()
+                fut = loop.run_in_executor(None, self._raw_read, sock.fileno())
+                fut.add_done_callback(
+                    lambda f: loop.call_soon_threadsafe(self._raw_read_done, f)
+                )
+                return
         self._expect("payload", self._plen)
+
+    def _raw_read(self, fd: int) -> None:
+        """Drain the payload into the preallocated buffer via os.readv on
+        the raw fd (executor thread; the socket stays non-blocking —
+        EAGAIN polls for readability).
+
+        ``select.poll`` (not select) — no FD_SETSIZE limit — and an
+        aggregate deadline so a peer that declares a payload then stalls
+        cannot pin a shared executor thread forever.
+        """
+        import os
+        import select
+
+        deadline = time.monotonic() + 120.0
+        poller = select.poll()
+        poller.register(fd, select.POLLIN)
+        view = self._payload_view
+        got = 0
+        while got < len(view):
+            try:
+                r = os.readv(fd, [view[got:]])
+                if r == 0:
+                    raise ConnectionError("peer closed mid-payload")
+                got += r
+            except (BlockingIOError, InterruptedError):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionError(
+                        f"peer stalled mid-payload ({got}/{len(view)} bytes)"
+                    )
+                poller.poll(min(remaining, 10.0) * 1000)
+
+    def _raw_read_done(self, fut) -> None:
+        try:
+            fut.result()
+        except Exception as e:
+            if not self._closed:
+                logger.warning(
+                    "[%s] payload read failed (peer=%s): %s",
+                    self._server._party, self._peer, e,
+                )
+                self._abort()
+            return
+        if self._closed:
+            return
+        self._transport.resume_reading()
+        self._got = self._need = self._plen  # state as if read via protocol
+        self._state = "payload"
+        if self._flags & wire.FLAG_CRC_TRAILER:
+            self._expect("trailer", 4)
+        else:
+            self._dispatch_frame()
 
     def _on_payload(self) -> None:
         if self._flags & wire.FLAG_CRC_TRAILER:

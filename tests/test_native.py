@@ -23,6 +23,40 @@ def test_crc32c_chaining_equals_whole():
         assert whole == native._crc32c_py(data)
 
 
+def test_crc32c_large_hits_interleaved_kernel():
+    """>=48KB inputs take the 6-lane GF(2)-combined fast path on the
+    compiled side — must match the bitwise pure-Python reference across
+    the threshold and with seed chaining (guards crc_shift_op/shift_tab
+    regressions that both peers would otherwise agree on silently)."""
+    rng = np.random.default_rng(7)
+    for n in (49_151, 49_152, 49_153, 200_000):
+        data = rng.integers(0, 255, n, dtype=np.uint8).tobytes()
+        assert native.crc32c(data) == native._crc32c_py(data), n
+        seed = 0x1234ABCD
+        assert native.crc32c(data, seed) == native._crc32c_py(data, seed), n
+    big = rng.integers(0, 255, 1 << 20, dtype=np.uint8).tobytes()
+    mid = native.crc32c(big[: 300_000])
+    assert native.crc32c(big) == native.crc32c(big[300_000:], seed=mid)
+
+
+def test_writev_full_roundtrip():
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        a.setblocking(False)
+        arr = np.arange(1000, dtype=np.uint16)
+        n = native.writev_full(a.fileno(), [b"head", arr, b"", b"tail"])
+        assert n == 4 + arr.nbytes + 4
+        got = bytearray()
+        while len(got) < n:
+            got.extend(b.recv(65536))
+        assert bytes(got) == b"head" + arr.tobytes() + b"tail"
+    finally:
+        a.close()
+        b.close()
+
+
 def test_gather_copy_and_crc():
     bufs = [b"abc", bytearray(b"defg"), np.arange(5, dtype=np.uint8)]
     expect = b"abcdefg" + bytes(range(5))

@@ -177,6 +177,29 @@ def test_sharded_decode_without_mesh_falls_back():
     assert out.shape == (2048, 2048)
 
 
+def test_sharded_host_decode_writable_by_default_view_on_optin():
+    """Host decode of shard-streamed leaves: writable owned arrays by
+    default; READONLY aliases of the payload only with zero_copy=True."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh2()
+    x = jnp.arange(4 * 1024 * 1024, dtype=jnp.float32).reshape(2048, 2048)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    bufs = wire.encode_payload(xs, lazy_shards=True)
+    payload = b"".join(
+        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
+        for b in bufs
+    )
+    default = wire.decode_payload(payload)
+    assert default.flags["WRITEABLE"]
+    default[0, 0] = 42.0  # in-place consumers keep working
+
+    view = wire.decode_payload(payload, zero_copy=True)
+    assert not view.flags["WRITEABLE"]
+    assert view.base is not None  # aliases the wire buffer
+    np.testing.assert_array_equal(view[1:], np.asarray(x)[1:])
+
+
 def test_small_arrays_stay_eager():
     x = jnp.ones((8, 8))
     bufs = wire.encode_payload({"x": x}, lazy_shards=True)
