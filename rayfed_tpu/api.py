@@ -318,12 +318,26 @@ class FedRemoteClass:
         return fed_actor_handle
 
 
+def _is_cython_callable(obj) -> bool:
+    """Cython-compiled functions (reference ``utils.py:131-144`` accepts
+    them): not caught by ``inspect.isfunction``; identified by their type
+    name plus the function-like attribute pair."""
+    name = type(obj).__name__
+    return name == "cython_function_or_method" or (
+        callable(obj)
+        and not inspect.isclass(obj)
+        and hasattr(obj, "func_name")  # cython's function-name attribute
+    )
+
+
 def remote(*args, **kwargs):
     """``@fed.remote`` decorator for functions and classes (ref ``api.py:332-350``)."""
 
     def _make_fed_remote(function_or_class, **options):
-        if inspect.isfunction(function_or_class) or inspect.isbuiltin(
-            function_or_class
+        if (
+            inspect.isfunction(function_or_class)
+            or inspect.isbuiltin(function_or_class)
+            or _is_cython_callable(function_or_class)
         ):
             return FedRemoteFunction(function_or_class).options(**options)
         if inspect.isclass(function_or_class):

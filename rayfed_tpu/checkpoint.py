@@ -151,6 +151,10 @@ class FedCheckpointer:
         npz fallback; with orbax it restores the saved structure and
         ``target`` is optional).
         """
+        # Finish any interrupted save first: an explicit round_num must
+        # also find a checkpoint the crash left as ``round_N.old``
+        # (rounds()/latest_round() already recover; this path must too).
+        self._recover()
         if round_num is None:
             round_num = self.latest_round()
             if round_num is None:

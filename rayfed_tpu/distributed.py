@@ -64,9 +64,22 @@ class PartyProcessGroup:
             num_processes=self.num_processes,
             process_id=self.process_id,
         )
-        from jax._src import distributed as _jdist
+        # The coordination-service KV client has no public accessor yet
+        # (tracked upstream); reach into jax._src behind a guard so a JAX
+        # upgrade that moves it fails loudly with an actionable message
+        # instead of an AttributeError deep in a send.
+        try:
+            from jax._src import distributed as _jdist
 
-        self._client = _jdist.global_state.client
+            self._client = _jdist.global_state.client
+        except (ImportError, AttributeError) as e:  # pragma: no cover
+            raise RuntimeError(
+                "rayfed_tpu's multi-host KV bridge uses the private "
+                "jax._src.distributed.global_state.client API (verified on "
+                "jax 0.4.30-0.9.x); this JAX build "
+                f"({jax.__version__}) no longer exposes it — pin a tested "
+                "JAX or port PartyProcessGroup to the replacement API"
+            ) from e
         if self._client is None:  # pragma: no cover
             raise RuntimeError("jax.distributed did not expose a KV client")
         self._published: List[Tuple[str, str, float]] = []

@@ -197,12 +197,20 @@ def writev_full(fd: int, buffers: Sequence, timeout_ms: int = 60_000) -> int:
     if not views:
         return 0
     if lib is None:
-        # Fallback: sequential sendall-style loop via os.write.
+        # Fallback: sequential write loop; mirrors the native path's
+        # non-blocking handling (EAGAIN → poll for writability).
+        import select
+
         total = 0
         for mv in views:
             off = 0
             while off < mv.nbytes:
-                off += os.write(fd, mv[off:])
+                try:
+                    off += os.write(fd, mv[off:])
+                except (BlockingIOError, InterruptedError):
+                    _, writable, _ = select.select([], [fd], [], timeout_ms / 1000)
+                    if not writable:
+                        raise OSError(110, "write stalled (poll timeout)")
             total += mv.nbytes
         return total
     n = len(views)

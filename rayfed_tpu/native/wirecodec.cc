@@ -20,9 +20,8 @@ extern "C" {
 // ---------------------------------------------------------------------------
 
 static uint32_t crc_table[8][256];
-static bool crc_init_done = false;
 
-static void crc_init() {
+static bool crc_init() {
   const uint32_t poly = 0x82f63b78u;  // reflected CRC32-C polynomial
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t crc = i;
@@ -37,8 +36,11 @@ static void crc_init() {
       crc_table[s][i] = crc;
     }
   }
-  crc_init_done = true;
+  return true;
 }
+
+// Built at dlopen — see shift_init_done for why not lazily.
+static const bool crc_init_done = crc_init();
 
 #if defined(__SSE4_2__)
 #include <nmmintrin.h>
@@ -92,9 +94,8 @@ static void crc_shift_op(uint32_t* out, uint64_t len_bytes) {
 static const uint64_t kLane = 8192;  // bytes per lane
 static const int kNL = 6;            // lanes
 static uint32_t shift_tab[kNL - 1][4][256];  // [s]: shift by (s+1)*kLane
-static bool shift_init_done = false;
 
-static void shift_init() {
+static bool shift_init() {
   uint32_t mat[32];
   for (int s = 0; s < kNL - 1; s++) {
     crc_shift_op(mat, (uint64_t)(s + 1) * kLane);
@@ -102,8 +103,13 @@ static void shift_init() {
       for (int v = 0; v < 256; v++)
         shift_tab[s][b][v] = gf2_times(mat, (uint32_t)v << (8 * b));
   }
-  shift_init_done = true;
+  return true;
 }
+
+// Built at dlopen (single-threaded): rf_crc32c runs with the GIL
+// released from many executor threads, and a lazy flag-guarded init
+// would be an unsynchronized data race.
+static const bool shift_init_done = shift_init();
 
 static inline uint32_t shift_apply(const uint32_t tab[4][256], uint32_t crc) {
   return tab[0][crc & 0xff] ^ tab[1][(crc >> 8) & 0xff] ^
@@ -117,7 +123,7 @@ uint32_t rf_crc32c(uint32_t seed, const uint8_t* data, uint64_t len) {
     len--;
   }
   if (len >= kNL * kLane) {
-    if (!shift_init_done) shift_init();
+    (void)shift_init_done;
     while (len >= kNL * kLane) {
       const uint64_t* p0 = reinterpret_cast<const uint64_t*>(data);
       const uint64_t* p1 = reinterpret_cast<const uint64_t*>(data + kLane);
@@ -157,7 +163,7 @@ uint32_t rf_crc32c(uint32_t seed, const uint8_t* data, uint64_t len) {
 }
 #else
 uint32_t rf_crc32c(uint32_t seed, const uint8_t* data, uint64_t len) {
-  if (!crc_init_done) crc_init();
+  (void)crc_init_done;
   uint32_t crc = ~seed;
   // Align to 8 bytes.
   while (len && (reinterpret_cast<uintptr_t>(data) & 7)) {

@@ -530,10 +530,18 @@ def flash_attention(
         interpret = not _on_tpu()
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     # Blocks must divide the sequence lengths: shrink the requested size
-    # to the largest 8-aligned divisor (e.g. T=1280 with block_k=512 →
-    # 256) instead of erroring on any non-multiple length.
+    # to the largest 8-aligned divisor (e.g. T=1280 with block_k=1024 →
+    # 640) instead of erroring on any non-multiple length.
     block_q = _fit_block(q.shape[1], block_q)
     block_k = _fit_block(k.shape[1], block_k)
+    if not interpret and (block_q % 8 or block_k % 8):
+        # No 8-aligned divisor exists (e.g. prime T): fail here with an
+        # actionable message instead of a Mosaic tiling error downstream.
+        raise ValueError(
+            f"sequence lengths ({q.shape[1]}, {k.shape[1]}) admit no "
+            f"8-aligned block split for the compiled TPU kernel — pad the "
+            f"sequence to a multiple of 8 or use dot_product_attention"
+        )
     return _flash_bthd(
         q, k, v, scale, causal, block_q, block_k,
         int(q_offset), int(kv_offset), interpret,

@@ -187,14 +187,17 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         the raw fd (executor thread; the socket stays non-blocking —
         EAGAIN polls for readability).
 
-        ``select.poll`` (not select) — no FD_SETSIZE limit — and an
-        aggregate deadline so a peer that declares a payload then stalls
-        cannot pin a shared executor thread forever.
+        ``select.poll`` (not select) — no FD_SETSIZE limit — and an IDLE
+        deadline (reset on every successful read) so a peer that
+        declares a payload then stalls cannot pin a shared executor
+        thread forever, while a slow-but-flowing large transfer is never
+        cut off.
         """
         import os
         import select
 
-        deadline = time.monotonic() + 120.0
+        idle_limit = 120.0
+        deadline = time.monotonic() + idle_limit
         poller = select.poll()
         poller.register(fd, select.POLLIN)
         view = self._payload_view
@@ -205,6 +208,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 if r == 0:
                     raise ConnectionError("peer closed mid-payload")
                 got += r
+                deadline = time.monotonic() + idle_limit
             except (BlockingIOError, InterruptedError):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

@@ -456,8 +456,12 @@ def decode_payload(
                 # caller gets a READONLY view pinning the payload buffer
                 # — the array is ~the whole payload, so nothing wasted.
                 total = sum(e["n"] for e in spec["shards"])
-                out = np.frombuffer(mv[offset : offset + total], dtype=dtype)
-                out = out.reshape(shape)
+                # toreadonly(): the live receive path hands us a
+                # bytearray, whose views are writable — the zero-copy
+                # contract is a READONLY alias (mutating it would
+                # corrupt the shared wire buffer silently).
+                region = mv[offset : offset + total].toreadonly()
+                out = np.frombuffer(region, dtype=dtype).reshape(shape)
                 offset += total
                 if device_put:
                     out = (

@@ -186,10 +186,12 @@ def test_sharded_host_decode_writable_by_default_view_on_optin():
     x = jnp.arange(4 * 1024 * 1024, dtype=jnp.float32).reshape(2048, 2048)
     xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
     bufs = wire.encode_payload(xs, lazy_shards=True)
-    payload = b"".join(
-        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
-        for b in bufs
-    )
+    # bytearray, like the live receive path (server._payload) — whose
+    # memoryviews are writable, so the READONLY contract must be
+    # enforced by decode itself, not inherited from an immutable input.
+    payload = bytearray()
+    for b in bufs:
+        payload += b.produce() if isinstance(b, wire.LazyBuffer) else bytes(b)
     default = wire.decode_payload(payload)
     assert default.flags["WRITEABLE"]
     default[0, 0] = 42.0  # in-place consumers keep working
