@@ -179,7 +179,8 @@ def init(
         if party_group.is_leader:
             inner = TransportManager(cluster_config, job_config)
             inner.mesh_provider = lambda: runtime.mesh
-            inner.start()
+            # NOT started here: MultiHostTransport must install its
+            # republish hook before the listener accepts the first frame.
         transport = MultiHostTransport(
             inner,
             party_group,
@@ -189,6 +190,9 @@ def init(
             # processes must time out together or not at all (a lone
             # non-leader failure desyncs the SPMD program).
             timeout_s=job_config.recv_backstop_s,
+            mesh_provider=lambda: runtime.mesh,
+            job_config=job_config,
+            tls_config=tls_config,
         )
     else:
         transport = TransportManager(cluster_config, job_config)

@@ -10,29 +10,28 @@ collectives riding ICI/DCN.
 Cross-party traffic stays on the push transport, but only **process 0 of
 each party (the leader)** runs it — one listener, one egress per party.
 Values a non-leader process needs (recv'd pushes, broadcast-on-get
-results) reach it through the **party process bridge**: the
-jax.distributed coordination service's key-value store, keyed by the
-same deterministic ``(upstream, downstream)`` rendezvous ids as the wire.
-The KV bridge is key-addressed and unordered, so recv futures may
-resolve in any order on any thread — no collective-ordering hazard (the
-ordered-collective alternative, ``multihost_utils.broadcast_one_to_all``,
-would require every process to resolve recvs in lockstep program order).
+results) reach it through the **party process bridge**: every non-leader
+runs its own :class:`TransportServer` instance and the leader re-pushes
+each received DATA frame's raw payload to it over the same wire stack
+(zero-copy frames, CRC, native writev) — bulk tensors never ride the
+coordination service.  The jax.distributed KV store carries only
+control metadata: the non-leaders' bridge addresses.
 
-Payload sizing: bridge values ride the coordination service (designed
-for metadata, not bulk tensors) — fine for control values, model deltas
-and CPU-test scale.  Bulk sharded arrays should instead be produced ON
-the party mesh (each process feeds its local shards) rather than pushed
-through a single leader; see ``parallel/sharding.py``.
+The bridge is keyed by the same deterministic ``(upstream, downstream)``
+rendezvous ids as the wire, and each process's mailbox is key-addressed
+and unordered — recv futures may resolve in any order on any thread with
+no collective-ordering hazard (the ordered-collective alternative,
+``multihost_utils.broadcast_one_to_all``, would require every process to
+resolve recvs in lockstep program order).
 """
 
 from __future__ import annotations
 
-import base64
-import concurrent.futures
+import asyncio
 import logging
+import socket as _socket
 import threading
-import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional
 
 from rayfed_tpu.executor import LocalRef
 
@@ -41,11 +40,24 @@ logger = logging.getLogger(__name__)
 _BRIDGE_PREFIX = "rayfed_bridge"
 
 
+def _local_host_ip() -> str:
+    """Best-effort address other party processes can reach this host at."""
+    try:
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))  # no packets sent; routes only
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except Exception:
+        return "127.0.0.1"
+
+
 class PartyProcessGroup:
     """This party's JAX process group (leader = process 0).
 
     Wraps ``jax.distributed.initialize`` plus the coordination-service
-    KV client used as the intra-party value bridge.
+    KV client used for control metadata (bridge addresses, barriers).
     """
 
     def __init__(
@@ -74,7 +86,7 @@ class PartyProcessGroup:
             self._client = _jdist.global_state.client
         except (ImportError, AttributeError) as e:  # pragma: no cover
             raise RuntimeError(
-                "rayfed_tpu's multi-host KV bridge uses the private "
+                "rayfed_tpu's multi-host control bridge uses the private "
                 "jax._src.distributed.global_state.client API (verified on "
                 "jax 0.4.30-0.9.x); this JAX build "
                 f"({jax.__version__}) no longer exposes it — pin a tested "
@@ -82,101 +94,36 @@ class PartyProcessGroup:
             ) from e
         if self._client is None:  # pragma: no cover
             raise RuntimeError("jax.distributed did not expose a KV client")
-        self._published: List[Tuple[str, str, float]] = []
-        self._published_lock = threading.Lock()
 
     @property
     def is_leader(self) -> bool:
         return self.process_id == 0
 
-    # -- KV bridge ------------------------------------------------------------
+    # -- control metadata ------------------------------------------------------
 
-    def _key(self, upstream_seq_id: Any, downstream_seq_id: Any) -> str:
-        return f"{_BRIDGE_PREFIX}/{upstream_seq_id}#{downstream_seq_id}"
-
-    def _ack_key(self, upstream_seq_id, downstream_seq_id, pid: int) -> str:
-        return (
-            f"{_BRIDGE_PREFIX}_ack/{upstream_seq_id}#{downstream_seq_id}/{pid}"
-        )
-
-    def publish(self, upstream_seq_id, downstream_seq_id, data: bytes) -> None:
-        """Leader-side: make a received value visible to all party processes."""
+    def publish_bridge_address(self, address: str) -> None:
+        """Non-leader: advertise this process's bridge listener."""
         self._client.key_value_set(
-            self._key(upstream_seq_id, downstream_seq_id),
-            base64.b64encode(data).decode("ascii"),
+            f"{_BRIDGE_PREFIX}_addr/{self.process_id}", address
         )
-        with self._published_lock:
-            self._published.append(
-                (str(upstream_seq_id), str(downstream_seq_id), time.monotonic())
-            )
 
-    def fetch(
-        self, upstream_seq_id, downstream_seq_id, timeout_s: float
-    ) -> bytes:
-        """Non-leader-side: block until the leader publishes the value."""
-        encoded = self._client.blocking_key_value_get(
-            self._key(upstream_seq_id, downstream_seq_id),
-            int(timeout_s * 1000),
+    def fetch_bridge_address(self, pid: int, timeout_s: float) -> str:
+        """Leader: resolve a non-leader's bridge listener address."""
+        return self._client.blocking_key_value_get(
+            f"{_BRIDGE_PREFIX}_addr/{pid}", int(timeout_s * 1000)
         )
-        # Ack so the leader's GC can delete the entry once every
-        # non-leader has read it (the coordination-service KV is for
-        # metadata — values must not accumulate for the job's lifetime).
-        try:
-            self._client.key_value_set(
-                self._ack_key(upstream_seq_id, downstream_seq_id, self.process_id),
-                "1",
-            )
-        except Exception:  # pragma: no cover
-            logger.debug("bridge ack failed", exc_info=True)
-        return base64.b64decode(encoded)
-
-    def _probe(self, key: str) -> bool:
-        try:
-            self._client.blocking_key_value_get(key, 1)
-            return True
-        except Exception:
-            return False
-
-    def gc_published(self, ttl_s: float = 3600.0) -> int:
-        """Leader-side: delete bridge entries every non-leader has acked
-        (or that exceeded the TTL).  Returns the number deleted."""
-        with self._published_lock:
-            tracked = list(self._published)
-        deleted = 0
-        now = time.monotonic()
-        keep = []
-        for up, down, t0 in tracked:
-            acked = all(
-                self._probe(self._ack_key(up, down, pid))
-                for pid in range(1, self.num_processes)
-            )
-            if acked or now - t0 > ttl_s:
-                for k in [self._key(up, down)] + [
-                    self._ack_key(up, down, pid)
-                    for pid in range(1, self.num_processes)
-                ]:
-                    try:
-                        self._client.key_value_delete(k)
-                    except Exception:  # pragma: no cover
-                        pass
-                deleted += 1
-            else:
-                keep.append((up, down, t0))
-        with self._published_lock:
-            # Re-merge entries published while GC ran.
-            fresh = [e for e in self._published if e not in tracked]
-            self._published = keep + fresh
-        return deleted
 
     def barrier(self, name: str, timeout_s: float = 120.0) -> None:
         self._client.wait_at_barrier(name, int(timeout_s * 1000))
 
     def cleanup(self) -> None:
-        """Best-effort removal of bridge keys (leader, at shutdown)."""
+        """Best-effort removal of bridge keys (leader, at shutdown) so a
+        re-init against the same coordination service can't resolve a
+        stale address from the previous incarnation."""
         if not self.is_leader:
             return
         try:
-            self._client.key_value_delete(_BRIDGE_PREFIX)
+            self._client.key_value_delete(f"{_BRIDGE_PREFIX}_addr")
         except Exception:  # pragma: no cover - older jax w/o dir delete
             logger.debug("bridge key cleanup not supported", exc_info=True)
 
@@ -189,66 +136,175 @@ class PartyProcessGroup:
             logger.debug("jax.distributed.shutdown failed", exc_info=True)
 
 
-def _encode_value(value: Any) -> bytes:
-    from rayfed_tpu.transport import wire
-
-    return b"".join(
-        bytes(b) if not isinstance(b, bytes) else b
-        for b in wire.encode_payload(value)
-    )
-
-
-def _decode_value(data: bytes, allowed: Optional[Dict], device_put: bool) -> Any:
-    from rayfed_tpu.transport import wire
-
-    return wire.decode_payload(data, allowed=allowed, device_put=device_put)
-
-
 class MultiHostTransport:
     """Send/recv proxy for a party spanning multiple JAX processes.
 
     - Leader: wraps the party's real :class:`TransportManager`; every
-      successful recv is additionally published on the process bridge.
-    - Non-leader: no wire at all — sends resolve ``True`` immediately
-      (the leader performs the real push; the same deterministic program
-      runs there), recvs fetch from the bridge.
+      received DATA frame's raw payload is additionally re-pushed to
+      each non-leader's bridge server over the wire stack.
+    - Non-leader: runs a bridge :class:`TransportManager` (listener on
+      an OS-assigned port, advertised via the coordination KV).  Sends
+      resolve ``True`` immediately (the leader performs the real push;
+      the same deterministic program runs there); recvs park on the
+      local bridge mailbox and decode with the full device_put /
+      mesh-re-shard path — each process places its own shards.
     """
 
     def __init__(
         self,
-        inner,  # TransportManager | None
+        inner,  # TransportManager (NOT yet started) | None
         group: PartyProcessGroup,
         *,
         allowed: Optional[Dict] = None,
         device_put_received: bool = True,
         timeout_s: float = 60.0,
+        mesh_provider=None,
+        job_config=None,
+        tls_config: Optional[Dict] = None,
     ) -> None:
         self._inner = inner
         self._group = group
         self._allowed = allowed
         self._device_put = device_put_received
         self._timeout_s = timeout_s
-        self._fetch_pool = (
-            None
-            if group.is_leader
-            else concurrent.futures.ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="rayfed-bridge-fetch"
-            )
-        )
-        self._gc_stop = threading.Event()
-        self._gc_thread: Optional[threading.Thread] = None
-        if group.is_leader and group.num_processes > 1:
-            def _gc_loop():
-                while not self._gc_stop.wait(15.0):
-                    try:
-                        self._group.gc_published()
-                    except Exception:  # pragma: no cover
-                        logger.debug("bridge GC error", exc_info=True)
+        self._job = job_config
+        self._tls_config = tls_config
+        self._bridge_mgr = None  # non-leader listener
+        self._bridge_clients: Dict[int, Any] = {}  # leader: pid -> client
+        self._bridge_ready = threading.Event()
 
-            self._gc_thread = threading.Thread(
-                target=_gc_loop, name="rayfed-bridge-gc", daemon=True
+        if group.num_processes <= 1:
+            self._bridge_ready.set()
+            if inner is not None:
+                inner.start()
+        elif group.is_leader:
+            self._start_leader_bridge()
+        else:
+            self._start_member_bridge(mesh_provider)
+
+    # -- bridge wiring ---------------------------------------------------------
+
+    def _bridge_job_config(self):
+        """Bridge-side job knobs: inherit the party's limits (a leader
+        republish larger than the bridge server's cap would be fatally
+        rejected and silently desync the SPMD program)."""
+        import dataclasses
+
+        from rayfed_tpu.config import JobConfig
+
+        base = self._job if self._job is not None else JobConfig()
+        return dataclasses.replace(
+            base,
+            device_put_received=self._device_put,
+            recv_backstop_s=self._timeout_s,
+        )
+
+    def _start_member_bridge(self, mesh_provider) -> None:
+        from rayfed_tpu.config import ClusterConfig, PartyConfig
+        from rayfed_tpu.transport.manager import TransportManager
+
+        me = f"bridge-p{self._group.process_id}"
+        cc = ClusterConfig(
+            parties={
+                me: PartyConfig.from_dict({"address": "0.0.0.0:0"})
+            },
+            current_party=me,
+            serializing_allowed_list=self._allowed,
+            # Same TLS posture as the cross-party wire: the bridge
+            # crosses the inter-host network too.
+            tls_config=self._tls_config,
+        )
+        self._bridge_mgr = TransportManager(cc, self._bridge_job_config())
+        self._bridge_mgr.mesh_provider = mesh_provider
+        self._bridge_mgr.start()
+        port = self._bridge_mgr._server.bound_port
+        self._group.publish_bridge_address(f"{_local_host_ip()}:{port}")
+        self._bridge_ready.set()
+
+    def _start_leader_bridge(self) -> None:
+        """Install the republish hook, start the wire, and resolve
+        non-leader addresses in the background.
+
+        Hook-before-start: a peer's push can land the instant the
+        listener accepts, and a frame received with no hook installed
+        would never reach the non-leaders (silent SPMD desync at
+        startup).  Republishes block until resolution completes.
+        """
+        from rayfed_tpu.config import RetryPolicy
+        from rayfed_tpu.transport import tls as tls_utils
+        from rayfed_tpu.transport.client import TransportClient
+
+        inner = self._inner
+        inner._server._on_message = self._on_leader_message
+        inner.start()
+
+        def _connect():
+            # Retry each address forever: a party process that never
+            # comes up means the job is stuck regardless, and "skip the
+            # missing process" would be a silent desync.  Loud beats
+            # degraded.
+            for pid in range(1, self._group.num_processes):
+                while True:
+                    try:
+                        addr = self._group.fetch_bridge_address(pid, 60.0)
+                        break
+                    except Exception as e:
+                        logger.warning(
+                            "bridge address for p%d not resolved yet (%s); "
+                            "retrying", pid, e,
+                        )
+                self._bridge_clients[pid] = TransportClient(
+                    src_party=inner._party,
+                    dest_party=f"bridge-p{pid}",
+                    address=addr,
+                    retry_policy=RetryPolicy(),
+                    timeout_s=inner._job.cross_silo_timeout_s,
+                    max_message_size=inner._job.cross_silo_messages_max_size,
+                    ssl_context=tls_utils.client_ssl_context(self._tls_config),
+                )
+            self._bridge_ready.set()
+
+        threading.Thread(
+            target=_connect, name="rayfed-bridge-connect", daemon=True
+        ).start()
+
+    def _on_leader_message(self, message) -> None:
+        # Runs on the inner loop thread; must not block.
+        asyncio.ensure_future(self._republish(message))
+
+    async def _republish(self, message) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._bridge_ready.is_set():
+            ok = await loop.run_in_executor(None, self._bridge_ready.wait, 60)
+            if not ok:
+                logger.error(
+                    "bridge clients still unresolved; republish of (%s, %s) "
+                    "waiting", message.upstream_seq_id, message.downstream_seq_id,
+                )
+        crc = None
+        clients = list(self._bridge_clients.items())
+        if clients and clients[0][1].checksum_enabled:
+            # One off-loop checksum, reused for every non-leader (the
+            # inline per-send path would recompute it N-1 times ON the
+            # event loop).
+            from rayfed_tpu import native
+
+            crc = await loop.run_in_executor(
+                None, native.crc32c, message.payload
             )
-            self._gc_thread.start()
+        for pid, client in clients:
+            try:
+                await client.send_data(
+                    [message.payload],
+                    message.upstream_seq_id,
+                    message.downstream_seq_id,
+                    crc=crc,
+                )
+            except Exception:
+                logger.exception(
+                    "bridge republish to p%d failed (up=%s down=%s)",
+                    pid, message.upstream_seq_id, message.downstream_seq_id,
+                )
 
     # -- proxy interface ------------------------------------------------------
 
@@ -265,69 +321,33 @@ class MultiHostTransport:
 
     def recv(self, src_party, upstream_seq_id, downstream_seq_id):
         if self._inner is not None:
-            ref = self._inner.recv(
+            return self._inner.recv(
                 src_party=src_party,
                 upstream_seq_id=upstream_seq_id,
                 downstream_seq_id=downstream_seq_id,
             )
-            if self._group.num_processes > 1:
-                def _publish(r: LocalRef) -> None:
-                    if r.exception() is not None:
-                        return
-                    try:
-                        self._group.publish(
-                            upstream_seq_id,
-                            downstream_seq_id,
-                            _encode_value(r.resolve()),
-                        )
-                    except Exception:
-                        logger.exception(
-                            "bridge publish failed for (%s, %s)",
-                            upstream_seq_id, downstream_seq_id,
-                        )
-
-                ref.add_done_callback(_publish)
-            return ref
-
-        out = LocalRef()
-
-        def _fetch():
-            try:
-                data = self._group.fetch(
-                    upstream_seq_id, downstream_seq_id, self._timeout_s
-                )
-                out.set_result(
-                    _decode_value(data, self._allowed, self._device_put)
-                )
-            except Exception as e:
-                out.set_exception(
-                    TimeoutError(
-                        f"bridge fetch of ({upstream_seq_id}, "
-                        f"{downstream_seq_id}) failed: {e}"
-                    )
-                )
-
-        self._fetch_pool.submit(_fetch)
-        return out
+        return self._bridge_mgr.recv(
+            src_party=src_party,
+            upstream_seq_id=upstream_seq_id,
+            downstream_seq_id=downstream_seq_id,
+        )
 
     def ping(self, dest_party: str, timeout_s: float = 1.0) -> bool:
         if self._inner is not None:
             return self._inner.ping(dest_party, timeout_s)
-        return True  # non-leaders have no wire to check
+        return True  # non-leaders have no cross-party wire to check
 
     def get_stats(self) -> Dict[str, Any]:
-        stats = self._inner.get_stats() if self._inner is not None else {}
+        mgr = self._inner if self._inner is not None else self._bridge_mgr
+        stats = mgr.get_stats() if mgr is not None else {}
         stats["party_process_id"] = self._group.process_id
         stats["party_num_processes"] = self._group.num_processes
         return stats
 
     def stop(self) -> None:
-        self._gc_stop.set()
-        if self._gc_thread is not None:
-            self._gc_thread.join(timeout=5)
         if self._inner is not None:
-            self._inner.stop()
-        if self._fetch_pool is not None:
-            self._fetch_pool.shutdown(wait=False)
+            self._inner.stop()  # also cancels bridge-client tasks (same loop)
+        if self._bridge_mgr is not None:
+            self._bridge_mgr.stop()
         self._group.cleanup()
         self._group.shutdown()

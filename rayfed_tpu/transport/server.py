@@ -426,8 +426,14 @@ class TransportServer:
             port=self._port,
             ssl=self._ssl_context,
         )
+        if self._port == 0:  # OS-assigned (bridge listeners)
+            self._port = self._server.sockets[0].getsockname()[1]
         logger.debug("[%s] transport server listening on %s:%s",
                      self._party, self._host, self._port)
+
+    @property
+    def bound_port(self) -> int:
+        return self._port
 
     async def stop(self) -> None:
         if self._server is not None:

@@ -257,6 +257,15 @@ def encode_payload(obj: Any, lazy_shards: bool = False) -> List:
     manifest_leaves: List[Dict[str, Any]] = []
     buffers: List = []
     for leaf in leaves:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            raise ValueError(
+                f"cannot encode a non-fully-addressable global array "
+                f"(shape {leaf.shape}) for a cross-party push: this "
+                f"process only holds its local shards.  Gather it onto "
+                f"the party's processes first (e.g. jax.experimental."
+                f"multihost_utils.process_allgather) or push per-process "
+                f"shards"
+            )
         if (
             lazy_shards
             and isinstance(leaf, jax.Array)
@@ -341,11 +350,15 @@ def _shards_tile_axis0(spec, shape) -> bool:
 def _place_shards_direct(mv, offset, spec, dtype, shape, sharding):
     """device_put each wire shard straight onto its target device.
 
-    When the receiver sharding's index map matches the sender's shard
-    layout exactly, each shard goes host→device with NO intermediate
-    whole-array assembly (the big win on real hardware: per-shard H2D
-    instead of host concat + re-split).  Returns (array, new_offset) or
-    (None, offset) to signal the host-assembly fallback.
+    When this process's addressable region of the receiver sharding is a
+    subset of the sender's shard layout, each local shard goes
+    host→device with NO intermediate whole-array assembly (the big win
+    on real hardware: per-shard H2D instead of host concat + re-split).
+    On a multi-host party mesh each process places only ITS OWN local
+    regions out of the full wire payload and the result is assembled
+    with ``make_array_from_single_device_arrays`` — which accepts a
+    non-fully-addressable (global) sharding.  Returns (array,
+    new_offset) or (None, offset) to signal the host-assembly fallback.
     """
     try:
         idx_map = sharding.addressable_devices_indices_map(shape)
@@ -361,17 +374,18 @@ def _place_shards_direct(mv, offset, spec, dtype, shape, sharding):
     wire_keys = [
         tuple((s, e) for s, e in entry["idx"]) for entry in spec["shards"]
     ]
-    if set(wire_keys) != set(by_index):
+    if not set(by_index) <= set(wire_keys):
         return None, offset
     arrays = []
     off = offset
     for entry, key in zip(spec["shards"], wire_keys):
         n = entry["n"]
-        extents = [e - s for s, e in entry["idx"]]
-        host = np.frombuffer(mv[off : off + n], dtype=dtype).reshape(extents)
+        if key in by_index:
+            extents = [e - s for s, e in entry["idx"]]
+            host = np.frombuffer(mv[off : off + n], dtype=dtype).reshape(extents)
+            for dev in by_index[key]:  # replicated axes: one copy per device
+                arrays.append(jax.device_put(host, dev))
         off += n
-        for dev in by_index[key]:  # replicated axes: one copy per device
-            arrays.append(jax.device_put(host, dev))
     arr = jax.make_array_from_single_device_arrays(shape, sharding, arrays)
     return arr, off
 
@@ -443,6 +457,13 @@ def decode_payload(
                 placed, new_offset = _place_shards_direct(
                     mv, offset, spec, dtype, shape, sharding
                 )
+            if placed is None and sharding is not None:
+                if not getattr(sharding, "is_fully_addressable", True):
+                    # Direct placement failed and a whole-array
+                    # device_put onto a global (multi-host) sharding
+                    # would throw — decode to the default placement and
+                    # let the caller re-shard explicitly.
+                    sharding = None
             if placed is not None:
                 leaves.append(placed)
                 offset = new_offset

@@ -70,6 +70,110 @@ def _run_member(role, rank, coord_port, cluster, q):
     q.put((role, rank, out))
 
 
+def _run_bulk_member(role, rank, coord_port, cluster, q):
+    """64MB sharded push to a 2-process party (VERDICT r2 item 6).
+
+    bob pushes a dp-sharded 64 MB array; it rides the wire to alice's
+    leader as per-shard lazy buffers, the leader re-pushes the raw
+    payload to alice/p1 over the socket bridge, and BOTH alice processes
+    place their own local shards onto the party's global 8-device mesh
+    (make_array_from_single_device_arrays with a non-fully-addressable
+    sharding) — then a jitted global sum reduces across processes.
+    """
+    from rayfed_tpu.utils import force_cpu_devices
+
+    # alice: 4 local devices per process -> 8-device global party mesh;
+    # bob: a normal single-process party with its own 8-device mesh.
+    force_cpu_devices(4 if role == "alice" else 8)
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+
+    if role == "alice":
+        fed.init(
+            address="local",
+            cluster=cluster,
+            party="alice",
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            num_party_processes=2,
+            party_process_id=rank,
+            mesh_shape={"dp": 8},
+        )
+    else:
+        fed.init(address="local", cluster=cluster, party="bob", mesh_shape={"dp": 8})
+
+    n_rows = 4096  # 4096 x 4096 f32 = 64 MB
+
+    @fed.remote
+    def make_big():
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from rayfed_tpu.api import get_runtime
+
+        mesh = get_runtime().mesh
+        x = jnp.ones((n_rows, 4096), jnp.float32)
+        return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+
+    @fed.remote
+    def alice_check(x):
+        from rayfed_tpu.transport import wire
+
+        assert isinstance(x, jax.Array), type(x)
+        # Each alice process holds only its 4 local shards of the global
+        # 8-way sharding — the leaf arrived per-shard, not replicated.
+        assert not x.is_fully_addressable
+        assert len(x.addressable_shards) == 4, len(x.addressable_shards)
+        # Pushing a non-fully-addressable global array back out must hit
+        # the encode guard with an actionable message, not an opaque
+        # runtime error (VERDICT r2 item 6).
+        try:
+            wire.encode_payload({"x": x})
+        except ValueError as e:
+            assert "non-fully-addressable" in str(e), e
+        else:
+            raise AssertionError("encode guard did not fire")
+        total = jax.jit(jnp.sum)(x)  # collective across both processes
+        return float(jax.device_get(total))
+
+    big = make_big.party("bob").remote()
+    out = fed.get(alice_check.party("alice").remote(big))
+    assert out == pytest.approx(float(n_rows * 4096)), out
+    fed.shutdown()
+    q.put((role, rank, out))
+
+
+def test_bulk_sharded_push_to_two_process_party():
+    coord_port, alice_port, bob_port = get_free_ports(3)
+    cluster = {
+        "alice": {"address": f"127.0.0.1:{alice_port}"},
+        "bob": {"address": f"127.0.0.1:{bob_port}"},
+    }
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    members = [("alice", 0), ("alice", 1), ("bob", 0)]
+    procs = [
+        ctx.Process(
+            target=_run_bulk_member,
+            args=(role, rank, coord_port, cluster, q),
+            name=f"bulk-{role}-{rank}",
+        )
+        for role, rank in members
+    ]
+    for p in procs:
+        p.start()
+    results = []
+    for _ in members:
+        results.append(q.get(timeout=240))
+    for p in procs:
+        p.join(30)
+        if p.is_alive():
+            p.terminate()
+            raise AssertionError("member process hung")
+    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+
+
 CLUSTER_PORTS = get_free_ports(3)
 
 
