@@ -1,0 +1,36 @@
+# Sphinx configuration for the rayfed_tpu documentation.
+#
+# Build (needs sphinx + a theme, not vendored in the runtime image):
+#   pip install sphinx furo
+#   sphinx-build -b html docs/source docs/_build/html
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "rayfed-tpu"
+copyright = "2026, rayfed-tpu developers"
+author = "rayfed-tpu developers"
+release = "0.2.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+
+intersphinx_mapping = {
+    "python": ("https://docs.python.org/3/", None),
+    "jax": ("https://docs.jax.dev/en/latest/", None),
+}
+
+autodoc_member_order = "bysource"
+autodoc_typehints = "description"
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = os.environ.get("RAYFED_TPU_DOCS_THEME", "alabaster")
+html_static_path = []

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Run lint (when available) + the full test suite the way CI does.
+# Tests force a virtual 8-device CPU mesh themselves (tests/conftest.py);
+# JAX_PLATFORMS=cpu keeps any accelerator out of the picture.
+
+set -e
+set -x
+
+cd "$(dirname "$0")"
+
+if command -v ruff >/dev/null 2>&1; then
+  ruff check rayfed_tpu tests bench.py
+else
+  echo "ruff not installed; skipping lint"
+fi
+
+JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
+
+echo "All tests finished."
