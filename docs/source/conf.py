@@ -12,7 +12,7 @@ sys.path.insert(0, os.path.abspath("../.."))
 project = "rayfed-tpu"
 copyright = "2026, rayfed-tpu developers"
 author = "rayfed-tpu developers"
-release = "0.2.0"
+release = "0.3.0"
 
 extensions = [
     "sphinx.ext.autodoc",
