@@ -55,6 +55,24 @@ class LlamaConfig:
     # float32 (see init_adam for why).
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # Rematerialization policy for the scanned layer body: None =
+    # recompute everything (lowest memory); "dots" = keep matmul outputs
+    # with no batch dims resident (jax.checkpoint_policies.
+    # dots_with_no_batch_dims_saveable) — ~5% higher MFU when the
+    # activations fit (v5e 1B bench: 0.522 -> 0.566 at b=2 seq=2048).
+    remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if self.remat_policy not in (None, "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                f"(expected None or 'dots')"
+            )
+        if self.remat_policy is not None and not self.remat:
+            raise ValueError(
+                "remat_policy is set but remat=False — the policy would "
+                "silently never apply; enable remat or drop the policy"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -210,7 +228,18 @@ def apply_llama(
         return x, None
 
     if config.remat:
-        layer_body = jax.checkpoint(layer_body)
+        if config.remat_policy is None:
+            layer_body = jax.checkpoint(layer_body)
+        elif config.remat_policy == "dots":
+            layer_body = jax.checkpoint(
+                layer_body,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:
+            raise ValueError(
+                f"unknown remat_policy {config.remat_policy!r} "
+                f"(expected None or 'dots')"
+            )
 
     scanned = {"w": params["layers"]}
     if lora_layers is not None:
