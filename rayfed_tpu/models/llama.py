@@ -228,17 +228,13 @@ def apply_llama(
         return x, None
 
     if config.remat:
+        # remat_policy values are validated in LlamaConfig.__post_init__.
         if config.remat_policy is None:
             layer_body = jax.checkpoint(layer_body)
-        elif config.remat_policy == "dots":
+        else:  # "dots"
             layer_body = jax.checkpoint(
                 layer_body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
-        else:
-            raise ValueError(
-                f"unknown remat_policy {config.remat_policy!r} "
-                f"(expected None or 'dots')"
             )
 
     scanned = {"w": params["layers"]}

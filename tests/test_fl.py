@@ -141,6 +141,75 @@ def test_split_fl_two_party():
     run_parties(run_split_fl, ["alice", "bob"], args=(SPLIT_CLUSTER,))
 
 
+BERT_SPLIT_CLUSTER = make_cluster(["alice", "bob"])
+
+
+def run_split_fl_bert(party, cluster=BERT_SPLIT_CLUSTER):
+    """BASELINE #5's exact shape: BERT encoder@alice -> head@bob.
+
+    Alice owns embeddings + transformer layers + pooler and ships pooled
+    [CLS] activations; bob owns the classification head and the labels,
+    shipping activation gradients back.  Token ids never leave alice,
+    labels never leave bob.
+    """
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import SplitTrainer
+    from rayfed_tpu.models import bert
+    from rayfed_tpu.models.logistic import softmax_cross_entropy
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    cfg = bert.BertConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=16,
+        num_classes=2,
+    )
+    n, t = 32, 8
+
+    full = bert.init_bert(jax.random.PRNGKey(0), cfg)
+    enc_params, head_params = bert.split_params(full)
+
+    @fed.remote
+    def load_ids():
+        return jax.random.randint(jax.random.PRNGKey(5), (n, t), 0, cfg.vocab_size)
+
+    @fed.remote
+    def load_labels():
+        # Learnable signal: label = parity of the first token id.
+        ids = jax.random.randint(jax.random.PRNGKey(5), (n, t), 0, cfg.vocab_size)
+        return (ids[:, 0] % 2).astype(jnp.int32)
+
+    def encoder_apply(params, ids):
+        hidden = bert.apply_encoder(params, ids, cfg)
+        return bert.apply_pooler(params, hidden)
+
+    trainer = SplitTrainer(
+        encoder_party="alice",
+        head_party="bob",
+        encoder_params=enc_params,
+        encoder_apply=encoder_apply,
+        head_params=head_params,
+        head_apply=bert.apply_head,
+        loss_fn=softmax_cross_entropy,
+        lr=0.05,
+    )
+
+    ids_obj = load_ids.party("alice").remote()
+    y_obj = load_labels.party("bob").remote()
+
+    losses = [float(fed.get(trainer.step(ids_obj, y_obj))) for _ in range(12)]
+    assert losses[-1] < losses[0], losses
+    fed.shutdown()
+
+
+def test_split_fl_bert():
+    run_parties(run_split_fl_bert, ["alice", "bob"], args=(BERT_SPLIT_CLUSTER,))
+
+
 PIPELINED_CLUSTER = make_cluster(["alice", "bob"])
 
 
