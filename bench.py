@@ -689,16 +689,26 @@ def main() -> None:
         _log(f"  flash: {extra}")
 
     if not compute_only:
-        _log("raw send-proxy push throughput (128MB sharded, loopback)...")
-        push, reshard = _one_child("_run_push_bench")
-        extra["push_GBps"] = round(push, 3)
-        extra["push_reshard_GBps"] = round(reshard, 3)
-        _log(f"  push: {push:.3f} GB/s wire, {reshard:.3f} GB/s with re-shard")
+        # Federated configs run lightest-first with a settle between
+        # them: on the 1-core bench host a predecessor's teardown
+        # (socket drain, page-cache churn from 128MB payloads) bleeds
+        # into the next child's measurement — the split-FL number was
+        # 4x lower when run straight after the push flood.
+        def _settle():
+            time.sleep(3)
 
         _log("split-FL activation push (CPU parties, real transport)...")
         gbps = _two_party("_run_split_party")
         extra["split_fl_GBps"] = round(gbps, 3)
         _log(f"  split: {gbps:.3f} GB/s")
+        _settle()
+
+        _log("raw send-proxy push throughput (128MB sharded, loopback)...")
+        push, reshard = _one_child("_run_push_bench")
+        extra["push_GBps"] = round(push, 3)
+        extra["push_reshard_GBps"] = round(reshard, 3)
+        _log(f"  push: {push:.3f} GB/s wire, {reshard:.3f} GB/s with re-shard")
+        _settle()
 
         _log("2-party Llama-LoRA federated fine-tune (CPU parties)...")
         lres = _multi_party("_run_lora_party")
@@ -707,6 +717,7 @@ def main() -> None:
         extra["lora_2party_rounds_per_sec"] = round(lrps, 3)
         extra["lora_adapter_MB_per_push"] = round(adapter_mb, 3)
         _log(f"  lora: {lrps:.3f} rounds/s, {adapter_mb:.3f} MB adapters/push")
+        _settle()
 
         _log("4-party ResNet-18 FedAvg (CPU parties, real transport)...")
         res = _multi_party("_run_resnet_party", RESNET_PARTIES)
@@ -715,6 +726,7 @@ def main() -> None:
         extra["resnet_4party_rounds_per_sec"] = round(rps, 3)
         extra["cross_party_GBps"] = round(xgbps, 3)
         _log(f"  resnet: {rps:.3f} rounds/s, {xgbps:.3f} GB/s cross-party")
+        _settle()
 
         metric = "fedavg_mnist_2party_rounds_per_sec"
         _log("2-party FedAvg (CPU parties, real transport)...")

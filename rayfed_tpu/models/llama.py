@@ -170,6 +170,35 @@ def _linear(x, w, lora_entry, dtype):
     return out
 
 
+def _no_lora(name):
+    return None
+
+
+def _qkv_proj(y, lp, config, b, t, lget=_no_lora):
+    """Project + reshape + RoPE-ready q/k/v — shared by the training
+    forward and the KV-cache decode step (keep their numerics in sync)."""
+    h, kv, dh = config.num_heads, config.num_kv_heads, config.head_dim
+    dtype = config.dtype
+    q = _linear(y, lp["wq"], lget("wq"), dtype).reshape(b, t, h, dh)
+    k = _linear(y, lp["wk"], lget("wk"), dtype).reshape(b, t, kv, dh)
+    v = _linear(y, lp["wv"], lget("wv"), dtype).reshape(b, t, kv, dh)
+    return q, k, v
+
+
+def _attn_out(x, attn, lp, config, b, t, lget=_no_lora):
+    flat = attn.reshape(b, t, config.num_heads * config.head_dim)
+    return x + _linear(flat, lp["wo"], lget("wo"), config.dtype)
+
+
+def _mlp_block(x, lp, config, lget=_no_lora):
+    """RMSNorm + SwiGLU MLP residual — shared by training and decode."""
+    dtype = config.dtype
+    y = _rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    gate = jax.nn.silu(_linear(y, lp["w_gate"], lget("w_gate"), dtype))
+    up = _linear(y, lp["w_up"], lget("w_up"), dtype)
+    return x + _linear(gate * up, lp["w_down"], lget("w_down"), dtype)
+
+
 def apply_llama(
     params: Params,
     input_ids: jax.Array,
@@ -209,9 +238,7 @@ def apply_llama(
             return {**ll[name], "scale": lora_scales[name]}
 
         y = _rms_norm(x, lp["attn_norm"], config.rms_eps)
-        q = _linear(y, lp["wq"], lget("wq"), dtype).reshape(b, t, h, dh)
-        k = _linear(y, lp["wk"], lget("wk"), dtype).reshape(b, t, kv, dh)
-        v = _linear(y, lp["wv"], lget("wv"), dtype).reshape(b, t, kv, dh)
+        q, k, v = _qkv_proj(y, lp, config, b, t, lget)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if kv != h:  # GQA: repeat kv heads to match query heads
@@ -219,23 +246,23 @@ def apply_llama(
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
         attn = attn_fn(q, k, v, causal=True)
-        x = x + _linear(attn.reshape(b, t, h * dh), lp["wo"], lget("wo"), dtype)
-
-        y = _rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        gate = jax.nn.silu(_linear(y, lp["w_gate"], lget("w_gate"), dtype))
-        up = _linear(y, lp["w_up"], lget("w_up"), dtype)
-        x = x + _linear(gate * up, lp["w_down"], lget("w_down"), dtype)
+        x = _attn_out(x, attn, lp, config, b, t, lget)
+        x = _mlp_block(x, lp, config, lget)
         return x, None
 
     if config.remat:
-        # remat_policy values are validated in LlamaConfig.__post_init__.
+        # Values are validated in LlamaConfig.__post_init__; the explicit
+        # dispatch stays exhaustive so a future policy added to the
+        # whitelist cannot silently fall through to the wrong one.
         if config.remat_policy is None:
             layer_body = jax.checkpoint(layer_body)
-        else:  # "dots"
+        elif config.remat_policy == "dots":
             layer_body = jax.checkpoint(
                 layer_body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
+        else:  # pragma: no cover — unreachable past __post_init__
+            raise AssertionError(config.remat_policy)
 
     scanned = {"w": params["layers"]}
     if lora_layers is not None:
@@ -256,6 +283,141 @@ def apply_llama(
         preferred_element_type=jnp.float32,
     )
     return logits
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding (autoregressive inference)
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Params:
+    """Static-shape KV cache: ``k``/``v`` are [L, B, max_len, KV, Dh].
+
+    Static shapes keep the decode step a single compiled XLA program —
+    position advances by ``dynamic_update_slice`` writes plus a length
+    mask, never a shape change.
+    """
+    kvh, dh, L = config.num_kv_heads, config.head_dim, config.num_layers
+    shape = (L, batch, max_len, kvh, dh)
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def make_decode_step(config: LlamaConfig):
+    """One-token autoregressive step as a single jitted program.
+
+    Returns ``step(params, cache, token_ids, pos) -> (cache, logits)``:
+    ``token_ids`` is [B] (this position's token per sequence), ``pos`` a
+    traced scalar position; ``logits`` is [B, V] float32 for the NEXT
+    token.  The cache (donated) is updated in place in HBM.
+
+    Numerics match :func:`apply_llama` at the same position (the layer
+    math is shared via ``_qkv_proj``/``_attn_out``/``_mlp_block``): same
+    RoPE tables, f32 softmax over the masked cache, bf16 MXU matmuls
+    with f32 accumulation for the lm_head.  Cached per config (frozen
+    dataclass) so repeat callers reuse the compiled program.
+    """
+    h, kvh, dh = config.num_heads, config.num_kv_heads, config.head_dim
+    dtype = config.dtype
+
+    def step(params, cache, token_ids, pos):
+        pos = jnp.asarray(pos)
+        b = token_ids.shape[0]
+        max_len = cache["k"].shape[2]
+        x = params["embed"].astype(dtype)[token_ids][:, None, :]  # [B,1,D]
+        cos, sin = rope_tables(pos[None], dh, config.rope_theta)
+        # Valid-length mask over the static cache: positions <= pos.
+        valid = jnp.arange(max_len) <= pos  # [T]
+
+        def layer_body(x, scanned):
+            lp = scanned["w"]
+            k_cache = scanned["k"]  # [B, T, KV, Dh]
+            v_cache = scanned["v"]
+
+            y = _rms_norm(x, lp["attn_norm"], config.rms_eps)
+            q, k, v = _qkv_proj(y, lp, config, b, 1)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
+            )
+            # GQA: group query heads over the shared kv head (g = H/KV).
+            g = h // kvh
+            qf = q.reshape(b, h, dh).astype(jnp.float32) * dh**-0.5
+            qf = qf.reshape(b, kvh, g, dh)
+            kf = k_cache.astype(jnp.float32)  # [B, T, KV, Dh]
+            s = jnp.einsum("bngd,btnd->bngt", qf, kf)
+            s = jnp.where(valid[None, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            vf = v_cache.astype(jnp.float32)
+            attn = jnp.einsum("bngt,btnd->bngd", p, vf)  # [B, KV, g, Dh]
+            attn = attn.reshape(b, 1, h, dh).astype(dtype)
+            x = _attn_out(x, attn, lp, config, b, 1)
+            x = _mlp_block(x, lp, config)
+            return x, {"k": k_cache, "v": v_cache}
+
+        scanned = {"w": params["layers"], "k": cache["k"], "v": cache["v"]}
+        x, new_cache = jax.lax.scan(layer_body, x, scanned)
+
+        x = _rms_norm(x[:, 0, :], params["final_norm"], config.rms_eps)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = jax.lax.dot_general(
+            x.astype(dtype),
+            head.astype(dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return new_cache, logits
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def greedy_generate(
+    params: Params,
+    config: LlamaConfig,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+) -> jax.Array:
+    """Greedy decoding: [B, T0] prompt → [B, T0 + max_new_tokens] ids.
+
+    Prefill feeds prompt tokens through the same decode step (one
+    compiled program for the whole generation via two ``lax.scan``s) —
+    correctness-first; a batched prefill is a drop-in upgrade.
+    """
+    b, t0 = prompt_ids.shape
+    max_len = t0 + max_new_tokens
+    cache = init_kv_cache(config, b, max_len)
+    step = make_decode_step(config)
+
+    def prefill_body(carry, i):
+        cache, _last = carry
+        cache, logits = step(params, cache, prompt_ids[:, i], i)
+        return (cache, logits), None
+
+    (cache, logits), _ = jax.lax.scan(
+        prefill_body,
+        (cache, jnp.zeros((b, config.vocab_size), jnp.float32)),
+        jnp.arange(t0),
+    )
+
+    def gen_body(carry, i):
+        cache, logits = carry
+        token = jnp.argmax(logits, axis=-1).astype(prompt_ids.dtype)
+        cache, logits = step(params, cache, token, t0 + i)
+        return (cache, logits), token
+
+    (_, logits), tokens = jax.lax.scan(
+        gen_body, (cache, logits), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt_ids, tokens.T], axis=1)
 
 
 def lm_loss(logits: jax.Array, targets: jax.Array, mask=None) -> jax.Array:
