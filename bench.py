@@ -580,6 +580,59 @@ def bench_llama() -> dict:
     }
 
 
+def bench_decode() -> dict:
+    """KV-cache greedy decoding throughput on the 1B bench model.
+
+    Slope between a short and a long generation (same prompt/prefill
+    work in both → the delta is pure steady-state decode), median-of-3.
+    """
+    import jax.numpy as jnp
+
+    from rayfed_tpu.models import llama
+    from rayfed_tpu.ops.flash_attention import flash_attention
+
+    cfg = llama.LlamaConfig(
+        vocab_size=16384,
+        hidden_size=2048,
+        num_layers=16,
+        num_heads=16,
+        num_kv_heads=8,
+        intermediate_size=8192,
+        max_seq_len=2048,
+        dtype=jnp.bfloat16,
+        param_dtype=jnp.bfloat16,
+    )
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    batch, t0 = 8, 128
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, t0), 0, cfg.vocab_size
+    )
+
+    def timed(n_new, reps=3):
+        g = jax.jit(
+            lambda p, pr: llama.greedy_generate(
+                p, cfg, pr, n_new, attn_fn=flash_attention
+            )
+        )
+        out = g(params, prompt)
+        jax.block_until_ready(out)
+        vals = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = g(params, prompt)
+            float(jax.device_get(jnp.sum(out)))
+            vals.append(time.perf_counter() - t)
+        return sorted(vals)[len(vals) // 2]
+
+    _log("  compiling decode generations (short+long)...")
+    n_short, n_long = 16, 528
+    per_tok = max((timed(n_long) - timed(n_short)) / (n_long - n_short), 1e-9)
+    return {
+        "decode_tokens_per_sec": round(batch / per_tok, 1),
+        "decode_step_ms": round(per_tok * 1e3, 2),
+    }
+
+
 def bench_flash() -> dict:
     """Flash (pallas) vs dense attention, fwd+bwd, causal, T=2048 + 4096.
 
@@ -685,6 +738,8 @@ def main() -> None:
         _log(f"compute benches on {jax.devices()[0].device_kind}...")
         extra.update(bench_llama())
         _log(f"  llama: {extra}")
+        extra.update(bench_decode())
+        _log(f"  decode: {extra}")
         extra.update(bench_flash())
         _log(f"  flash: {extra}")
 

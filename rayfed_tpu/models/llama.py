@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from rayfed_tpu.ops.attention import dot_product_attention
+from rayfed_tpu.ops.attention import NEG_INF, dot_product_attention
 
 Params = Dict[str, Any]
 
@@ -199,6 +199,47 @@ def _mlp_block(x, lp, config, lget=_no_lora):
     return x + _linear(gate * up, lp["w_down"], lget("w_down"), dtype)
 
 
+def _layer_fwd(x, lp, config, cos, sin, attn_fn, b, t, lget=_no_lora,
+               emit_kv=False):
+    """One decoder layer (norm→qkv→RoPE→GQA attn→out→MLP) — the single
+    implementation behind the training forward AND prefill, so their
+    numerics cannot drift.  With ``emit_kv`` also returns the pre-repeat
+    k/v (for KV-cache assembly)."""
+    h, kv = config.num_heads, config.num_kv_heads
+    y = _rms_norm(x, lp["attn_norm"], config.rms_eps)
+    q, k, v = _qkv_proj(y, lp, config, b, t, lget)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    k_out, v_out = k, v
+    if kv != h:  # GQA: repeat kv heads to match query heads
+        reps = h // kv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    attn = attn_fn(q, k, v, causal=True)
+    x = _attn_out(x, attn, lp, config, b, t, lget)
+    x = _mlp_block(x, lp, config, lget)
+    return (x, (k_out, v_out)) if emit_kv else (x, None)
+
+
+def _lm_head(x, params, config):
+    """Final norm + vocabulary projection ([..., D] → [..., V] f32).
+
+    bf16 MXU operands, f32 accumulation — a pure-f32 lm_head matmul runs
+    at a fraction of bf16 throughput and the f32 accumulator already
+    carries the precision the loss needs.
+    """
+    x = _rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return jax.lax.dot_general(
+        x.astype(config.dtype),
+        head.astype(config.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def apply_llama(
     params: Params,
     input_ids: jax.Array,
@@ -237,18 +278,7 @@ def apply_llama(
                 return None
             return {**ll[name], "scale": lora_scales[name]}
 
-        y = _rms_norm(x, lp["attn_norm"], config.rms_eps)
-        q, k, v = _qkv_proj(y, lp, config, b, t, lget)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        if kv != h:  # GQA: repeat kv heads to match query heads
-            reps = h // kv
-            k = jnp.repeat(k, reps, axis=2)
-            v = jnp.repeat(v, reps, axis=2)
-        attn = attn_fn(q, k, v, causal=True)
-        x = _attn_out(x, attn, lp, config, b, t, lget)
-        x = _mlp_block(x, lp, config, lget)
-        return x, None
+        return _layer_fwd(x, lp, config, cos, sin, attn_fn, b, t, lget)
 
     if config.remat:
         # Values are validated in LlamaConfig.__post_init__; the explicit
@@ -269,20 +299,7 @@ def apply_llama(
         scanned["lora"] = lora_layers
     x, _ = jax.lax.scan(layer_body, x, scanned)
 
-    x = _rms_norm(x, params["final_norm"], config.rms_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    # bf16 MXU operands, f32 accumulation — a pure-f32 lm_head matmul
-    # runs at a fraction of bf16 throughput and the f32 accumulator
-    # already carries the precision the loss needs.
-    logits = jax.lax.dot_general(
-        x.astype(dtype),
-        head.astype(dtype),
-        (((x.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    return logits
+    return _lm_head(x, params, config)
 
 
 # ---------------------------------------------------------------------------
@@ -348,15 +365,22 @@ def make_decode_step(config: LlamaConfig):
                 v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
             )
             # GQA: group query heads over the shared kv head (g = H/KV).
+            # Native-dtype (bf16) MXU operands with f32 accumulation —
+            # casting the whole static cache to f32 would materialize
+            # multi-MB copies per layer in the per-token hot loop.
             g = h // kvh
-            qf = q.reshape(b, h, dh).astype(jnp.float32) * dh**-0.5
-            qf = qf.reshape(b, kvh, g, dh)
-            kf = k_cache.astype(jnp.float32)  # [B, T, KV, Dh]
-            s = jnp.einsum("bngd,btnd->bngt", qf, kf)
-            s = jnp.where(valid[None, None, None, :], s, -1e30)
+            qs = (q.reshape(b, h, dh) * dh**-0.5).astype(dtype)
+            qs = qs.reshape(b, kvh, g, dh)
+            s = jnp.einsum(
+                "bngd,btnd->bngt", qs, k_cache,
+                preferred_element_type=jnp.float32,
+            )
+            s = jnp.where(valid[None, None, None, :], s, NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
-            vf = v_cache.astype(jnp.float32)
-            attn = jnp.einsum("bngt,btnd->bngd", p, vf)  # [B, KV, g, Dh]
+            attn = jnp.einsum(
+                "bngt,btnd->bngd", p.astype(v_cache.dtype), v_cache,
+                preferred_element_type=jnp.float32,
+            )  # [B, KV, g, Dh]
             attn = attn.reshape(b, 1, h, dh).astype(dtype)
             x = _attn_out(x, attn, lp, config, b, 1)
             x = _mlp_block(x, lp, config)
@@ -365,19 +389,46 @@ def make_decode_step(config: LlamaConfig):
         scanned = {"w": params["layers"], "k": cache["k"], "v": cache["v"]}
         x, new_cache = jax.lax.scan(layer_body, x, scanned)
 
-        x = _rms_norm(x[:, 0, :], params["final_norm"], config.rms_eps)
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"].T
-        logits = jax.lax.dot_general(
-            x.astype(dtype),
-            head.astype(dtype),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return new_cache, logits
+        return new_cache, _lm_head(x[:, 0, :], params, config)
 
     return jax.jit(step, donate_argnums=(1,))
+
+
+def prefill(
+    params: Params,
+    config: LlamaConfig,
+    prompt_ids: jax.Array,
+    max_len: int,
+    *,
+    attn_fn: Callable = dot_product_attention,
+) -> Tuple[Params, jax.Array]:
+    """Process the whole prompt in ONE causal pass and return
+    ``(cache, last_logits)`` ready for :func:`make_decode_step`.
+
+    Same layer math as :func:`apply_llama` (shared helpers), but the
+    scan also emits each layer's k/v, zero-padded into the static
+    [L, B, max_len, KV, Dh] cache layout.  O(T) matmul width instead of
+    T sequential single-token steps.
+    """
+    b, t0 = prompt_ids.shape
+    if t0 > max_len:
+        raise ValueError(f"prompt length {t0} exceeds cache max_len {max_len}")
+    dtype = config.dtype
+    h, kv, dh = config.num_heads, config.num_kv_heads, config.head_dim
+
+    x = params["embed"].astype(dtype)[prompt_ids]
+    cos, sin = rope_tables(jnp.arange(t0), dh, config.rope_theta)
+
+    def layer_body(x, lp):
+        x, (k_out, v_out) = _layer_fwd(
+            x, lp, config, cos, sin, attn_fn, b, t0, emit_kv=True
+        )
+        pad = [(0, 0), (0, max_len - t0), (0, 0), (0, 0)]
+        return x, {"k": jnp.pad(k_out, pad), "v": jnp.pad(v_out, pad)}
+
+    x, cache = jax.lax.scan(layer_body, x, params["layers"])
+
+    return cache, _lm_head(x[:, -1, :], params, config)
 
 
 def greedy_generate(
@@ -385,28 +436,20 @@ def greedy_generate(
     config: LlamaConfig,
     prompt_ids: jax.Array,
     max_new_tokens: int,
+    *,
+    attn_fn: Callable = dot_product_attention,
 ) -> jax.Array:
     """Greedy decoding: [B, T0] prompt → [B, T0 + max_new_tokens] ids.
 
-    Prefill feeds prompt tokens through the same decode step (one
-    compiled program for the whole generation via two ``lax.scan``s) —
-    correctness-first; a batched prefill is a drop-in upgrade.
+    One batched causal pass over the prompt (:func:`prefill`, pass
+    ``attn_fn=flash_attention`` for long prompts — dense attention
+    materializes the [B,H,T,T] score tensor), then one ``lax.scan`` of
+    single-token steps through the KV cache.
     """
     b, t0 = prompt_ids.shape
     max_len = t0 + max_new_tokens
-    cache = init_kv_cache(config, b, max_len)
+    cache, logits = prefill(params, config, prompt_ids, max_len, attn_fn=attn_fn)
     step = make_decode_step(config)
-
-    def prefill_body(carry, i):
-        cache, _last = carry
-        cache, logits = step(params, cache, prompt_ids[:, i], i)
-        return (cache, logits), None
-
-    (cache, logits), _ = jax.lax.scan(
-        prefill_body,
-        (cache, jnp.zeros((b, config.vocab_size), jnp.float32)),
-        jnp.arange(t0),
-    )
 
     def gen_body(carry, i):
         cache, logits = carry

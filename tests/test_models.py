@@ -238,6 +238,30 @@ def test_llama_kv_cache_decode_matches_full_forward():
     )
 
 
+def test_llama_prefill_matches_sequential_decode():
+    """Batched prefill must produce the same cache + last-token logits
+    as feeding the prompt through the decode step one token at a time."""
+    cfg = llama.llama_tiny()
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+
+    cache_p, logits_p = llama.prefill(params, cfg, ids, 12)
+    cache_s = llama.init_kv_cache(cfg, 2, 12)
+    step = llama.make_decode_step(cfg)
+    for t in range(8):
+        cache_s, logits_s = step(params, cache_s, ids[:, t], t)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(cache_p[key]), np.asarray(cache_s[key]),
+            rtol=1e-4, atol=1e-5,
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=2e-4, atol=2e-4
+    )
+    with pytest.raises(ValueError, match="max_len"):
+        llama.prefill(params, cfg, ids, 4)
+
+
 def test_llama_greedy_generate():
     """Generated tokens must equal the full forward's argmax at each
     position (self-consistency of prefill + generation scans)."""
