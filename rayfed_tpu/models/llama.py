@@ -431,6 +431,69 @@ def prefill(
     return cache, _lm_head(x[:, -1, :], params, config)
 
 
+def generate(
+    params: Params,
+    config: LlamaConfig,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    attn_fn: Callable = dot_product_attention,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive decoding: [B, T0] prompt → [B, T0+max_new_tokens].
+
+    One batched causal pass over the prompt (:func:`prefill`, pass
+    ``attn_fn=flash_attention`` for long prompts — dense attention
+    materializes the [B,H,T,T] score tensor), then one ``lax.scan`` of
+    single-token steps through the KV cache.
+
+    ``temperature=0`` (default) is greedy argmax.  With a positive
+    temperature, samples from softmax(logits/temperature), optionally
+    truncated to the ``top_k`` most likely tokens; ``key`` is then
+    required.
+    """
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires key=")
+    if top_k is not None and not 0 < top_k <= config.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={config.vocab_size}], got {top_k}"
+        )
+    b, t0 = prompt_ids.shape
+    max_len = t0 + max_new_tokens
+    cache, logits = prefill(params, config, prompt_ids, max_len, attn_fn=attn_fn)
+    step = make_decode_step(config)
+    keys = (
+        jax.random.split(key, max_new_tokens)
+        if temperature > 0.0
+        else jnp.zeros((max_new_tokens, 2), jnp.uint32)
+    )
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        scaled = logits / temperature
+        if top_k is not None:
+            # Partial top-k, not a full vocab sort — this runs inside
+            # the per-token decode loop.
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(k, scaled, axis=-1)
+
+    def gen_body(carry, inputs):
+        i, k = inputs
+        cache, logits = carry
+        token = pick(logits, k).astype(prompt_ids.dtype)
+        cache, logits = step(params, cache, token, t0 + i)
+        return (cache, logits), token
+
+    (_, logits), tokens = jax.lax.scan(
+        gen_body, (cache, logits), (jnp.arange(max_new_tokens), keys)
+    )
+    return jnp.concatenate([prompt_ids, tokens.T], axis=1)
+
+
 def greedy_generate(
     params: Params,
     config: LlamaConfig,
@@ -439,28 +502,10 @@ def greedy_generate(
     *,
     attn_fn: Callable = dot_product_attention,
 ) -> jax.Array:
-    """Greedy decoding: [B, T0] prompt → [B, T0 + max_new_tokens] ids.
-
-    One batched causal pass over the prompt (:func:`prefill`, pass
-    ``attn_fn=flash_attention`` for long prompts — dense attention
-    materializes the [B,H,T,T] score tensor), then one ``lax.scan`` of
-    single-token steps through the KV cache.
-    """
-    b, t0 = prompt_ids.shape
-    max_len = t0 + max_new_tokens
-    cache, logits = prefill(params, config, prompt_ids, max_len, attn_fn=attn_fn)
-    step = make_decode_step(config)
-
-    def gen_body(carry, i):
-        cache, logits = carry
-        token = jnp.argmax(logits, axis=-1).astype(prompt_ids.dtype)
-        cache, logits = step(params, cache, token, t0 + i)
-        return (cache, logits), token
-
-    (_, logits), tokens = jax.lax.scan(
-        gen_body, (cache, logits), jnp.arange(max_new_tokens)
+    """Greedy decoding (temperature-0 :func:`generate`)."""
+    return generate(
+        params, config, prompt_ids, max_new_tokens, attn_fn=attn_fn
     )
-    return jnp.concatenate([prompt_ids, tokens.T], axis=1)
 
 
 def lm_loss(logits: jax.Array, targets: jax.Array, mask=None) -> jax.Array:

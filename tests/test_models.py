@@ -278,6 +278,35 @@ def test_llama_greedy_generate():
         )
 
 
+def test_llama_sampled_generate():
+    """Sampling: valid token range, deterministic per key, top_k
+    truncation only draws from the k most likely tokens."""
+    cfg = llama.llama_tiny()
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, cfg.vocab_size)
+
+    g1 = llama.generate(
+        params, cfg, prompt, 5, temperature=1.0, key=jax.random.PRNGKey(3)
+    )
+    g2 = llama.generate(
+        params, cfg, prompt, 5, temperature=1.0, key=jax.random.PRNGKey(3)
+    )
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    assert g1.shape == (2, 9)
+    assert int(jnp.min(g1)) >= 0 and int(jnp.max(g1)) < cfg.vocab_size
+
+    # top_k=1 must equal greedy regardless of temperature.
+    topk1 = llama.generate(
+        params, cfg, prompt, 5, temperature=2.0, top_k=1,
+        key=jax.random.PRNGKey(4),
+    )
+    greedy = llama.greedy_generate(params, cfg, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+    with pytest.raises(ValueError, match="key"):
+        llama.generate(params, cfg, prompt, 5, temperature=1.0)
+
+
 def test_llama_remat_policy_validation():
     import pytest
 
