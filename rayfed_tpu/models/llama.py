@@ -454,8 +454,17 @@ def generate(
     truncated to the ``top_k`` most likely tokens; ``key`` is then
     required.
     """
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) requires key=")
+    if temperature == 0.0 and (key is not None or top_k is not None):
+        # The mirror mistake of the check above: sampling args that would
+        # be silently ignored under greedy decoding.
+        raise ValueError(
+            "key/top_k are sampling arguments — pass temperature > 0 "
+            "(or drop them for greedy decoding)"
+        )
     if top_k is not None and not 0 < top_k <= config.vocab_size:
         raise ValueError(
             f"top_k must be in [1, vocab_size={config.vocab_size}], got {top_k}"

@@ -41,6 +41,35 @@ def _on_tpu() -> bool:
         return False
 
 
+def _causal_dispatch(
+    compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+):
+    """Run ``compute(masked)`` under the causal block classification.
+
+    A block strictly past the diagonal contributes nothing (skipped); a
+    block entirely at-or-before it needs no mask; only blocks straddling
+    the diagonal pay for the iota/compare/select.  Shared by all three
+    kernels so the boundary conditions cannot drift.
+    """
+    if not causal:
+        compute(False)
+        return
+    q_first = q_offset + qi * block_q
+    q_last = q_first + block_q - 1
+    kv_first = kv_offset + ki * block_k
+    kv_last = kv_first + block_k - 1
+    active = kv_first <= q_last
+    straddles = kv_last > q_first
+
+    @pl.when(active & jnp.logical_not(straddles))
+    def _full():
+        compute(False)
+
+    @pl.when(active & straddles)
+    def _diag():
+        compute(True)
+
+
 def _flash_fwd_kernel(
     q_ref,  # (1, block_q, d)
     k_ref,  # (1, block_k, d)
@@ -69,17 +98,11 @@ def _flash_fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # Under causality a kv block strictly after the last query row of this
-    # q block contributes nothing — skip its matmuls entirely.  Offsets
-    # are static (compile-time) global positions of the first q/kv token.
-    should_compute = True
-    if causal:
-        should_compute = (
-            kv_offset + ki * block_k
-            <= q_offset + qi * block_q + block_q - 1
-        )
-
-    @pl.when(should_compute)
-    def _compute():
+    # q block contributes nothing — skip its matmuls entirely; a block
+    # entirely at-or-before the diagonal needs no mask — skip the iota/
+    # compare/select (only diagonal-straddling blocks pay for masking).
+    # Offsets are static (compile-time) positions of the first q/kv token.
+    def _compute(masked: bool):
         # Feed the MXU native-dtype (bf16) operands — casting to f32 first
         # would force f32 matmul passes at a fraction of bf16 throughput.
         # Accumulation is f32 via preferred_element_type.
@@ -89,7 +112,7 @@ def _flash_fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (block_q, block_k) f32
-        if causal:
+        if masked:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -117,6 +140,10 @@ def _flash_fwd_kernel(
         acc_ref[...] = acc_ref[...] * correction + pv
         m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    _causal_dispatch(
+        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+    )
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -226,14 +253,7 @@ def _flash_bwd_dq_kernel(
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    should_compute = True
-    if causal:
-        should_compute = (
-            kv_offset + ki * block_k <= q_offset + qi * block_q + block_q - 1
-        )
-
-    @pl.when(should_compute)
-    def _compute():
+    def _compute(masked: bool):
         # Native-dtype (bf16) MXU operands, f32 accumulation — see fwd.
         q = q_ref[0]
         k = k_ref[0]
@@ -244,7 +264,7 @@ def _flash_bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if masked:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -252,8 +272,10 @@ def _flash_bwd_dq_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        # exp(s - lse); fully-masked rows have lse ~ NEG_INF — zero them.
-        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+            # exp(s - lse); fully-masked rows have lse ~ NEG_INF — zero.
+            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        else:
+            p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -262,6 +284,10 @@ def _flash_bwd_dq_kernel(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    _causal_dispatch(
+        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+    )
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -297,15 +323,7 @@ def _flash_bwd_dkv_kernel(
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    should_compute = True
-    if causal:
-        # A q block strictly before this kv block sees none of it.
-        should_compute = (
-            q_offset + qi * block_q + block_q - 1 >= kv_offset + ki * block_k
-        )
-
-    @pl.when(should_compute)
-    def _compute():
+    def _compute(masked: bool):
         # Native-dtype (bf16) MXU operands, f32 accumulation — see fwd.
         q = q_ref[0]
         k = k_ref[0]
@@ -316,7 +334,7 @@ def _flash_bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (block_q, block_k)
-        if causal:
+        if masked:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -324,7 +342,9 @@ def _flash_bwd_dkv_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        else:
+            p = jnp.exp(s - lse)
         dv_acc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -337,6 +357,10 @@ def _flash_bwd_dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # dsᵀ @ q (un-normalized; scale applied at finalize)
+
+    _causal_dispatch(
+        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+    )
 
     @pl.when(qi == num_q - 1)
     def _finalize():

@@ -303,8 +303,25 @@ def test_llama_sampled_generate():
     greedy = llama.greedy_generate(params, cfg, prompt, 5)
     np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
 
+    # top_k=2 at a hot temperature: every sampled token must be one of
+    # the 2 most likely continuations of its prefix (truncation really
+    # constrains the draw).
+    topk2 = llama.generate(
+        params, cfg, prompt, 8, temperature=5.0, top_k=2,
+        key=jax.random.PRNGKey(5),
+    )
+    full = llama.apply_llama(params, topk2, cfg)
+    for t in range(4, 12):
+        allowed = jax.lax.top_k(full[:, t - 1], 2)[1]
+        for row in range(2):
+            assert int(topk2[row, t]) in np.asarray(allowed[row]), (row, t)
+
     with pytest.raises(ValueError, match="key"):
         llama.generate(params, cfg, prompt, 5, temperature=1.0)
+    with pytest.raises(ValueError, match="sampling arguments"):
+        llama.generate(params, cfg, prompt, 5, top_k=4)
+    with pytest.raises(ValueError, match="temperature"):
+        llama.generate(params, cfg, prompt, 5, temperature=-1.0)
 
 
 def test_llama_remat_policy_validation():
