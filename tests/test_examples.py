@@ -1,0 +1,19 @@
+"""The shipped examples must stay runnable: CI drives each example's
+``run()`` in real per-party processes (same code path as
+``python examples/<name>.py``), so the files the docs point users at
+cannot silently drift from the tested behavior."""
+
+from tests.multiproc import run_parties
+
+from examples.fedavg_mnist import run as run_fedavg_example
+from examples.lora_finetune import run as run_lora_example
+
+
+def test_fedavg_mnist_example():
+    # Fewer rounds than the standalone default: this is a liveness
+    # check, the convergence assertions live in tests/test_fl.py.
+    run_parties(run_fedavg_example, ["alice", "bob"], args=(2,), timeout=240)
+
+
+def test_lora_finetune_example():
+    run_parties(run_lora_example, ["alice", "bob"], args=(1,), timeout=240)
