@@ -24,6 +24,7 @@ Public API surface mirrors the reference (``fed/__init__.py:15-29``):
 """
 
 from rayfed_tpu.api import init, shutdown, remote, get, kill
+from rayfed_tpu.exceptions import RemoteError
 from rayfed_tpu.fed_object import FedObject
 from rayfed_tpu.metrics import get_stats
 from rayfed_tpu.proxy import send, recv
@@ -40,6 +41,7 @@ __all__ = [
     "send",
     "recv",
     "FedObject",
+    "RemoteError",
     "tree_util",
     "get_stats",
     "__version__",

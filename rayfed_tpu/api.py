@@ -194,6 +194,11 @@ def init(
             job_config=job_config,
             tls_config=tls_config,
         )
+        # A fatal bridge republish is a send failure for watchdog
+        # purposes: exit-on-failure applies to the intra-party bridge too.
+        transport.failure_handler = (
+            lambda ref, exc: runtime.cleanup_manager.push_to_sending(ref)
+        )
     else:
         transport = TransportManager(cluster_config, job_config)
         transport.mesh_provider = lambda: runtime.mesh
@@ -324,13 +329,16 @@ class FedRemoteClass:
 
 def _is_cython_callable(obj) -> bool:
     """Cython-compiled functions (reference ``utils.py:131-144`` accepts
-    them): not caught by ``inspect.isfunction``; identified by their type
-    name plus the function-like attribute pair."""
-    name = type(obj).__name__
-    return name == "cython_function_or_method" or (
-        callable(obj)
-        and not inspect.isclass(obj)
-        and hasattr(obj, "func_name")  # cython's function-name attribute
+    them): not caught by ``inspect.isfunction``; identified by the type
+    name ``cython_function_or_method`` on the object itself or — for
+    Cython 3 bound methods, which expose ``__func__`` rather than
+    ``func_name`` — on its underlying function."""
+
+    def _is_cython_type(o) -> bool:
+        return type(o).__name__ == "cython_function_or_method"
+
+    return _is_cython_type(obj) or (
+        hasattr(obj, "__func__") and _is_cython_type(obj.__func__)
     )
 
 

@@ -41,7 +41,16 @@ _BRIDGE_PREFIX = "rayfed_bridge"
 
 
 def _local_host_ip() -> str:
-    """Best-effort address other party processes can reach this host at."""
+    """Address other party processes can reach this host at.
+
+    On multi-homed hosts the default-route interface may not be the one
+    the leader can reach; ``RAYFED_BRIDGE_HOST`` overrides the heuristic.
+    """
+    import os
+
+    override = os.environ.get("RAYFED_BRIDGE_HOST")
+    if override:
+        return override
     try:
         s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
         try:
@@ -172,6 +181,16 @@ class MultiHostTransport:
         self._bridge_mgr = None  # non-leader listener
         self._bridge_clients: Dict[int, Any] = {}  # leader: pid -> client
         self._bridge_ready = threading.Event()
+        # Loop-side twin of _bridge_ready: republish coroutines await this
+        # instead of parking shared executor threads in a blocking wait
+        # (a burst of early frames would otherwise occupy the same
+        # executor the server raw-read and writev paths use).  Created
+        # lazily ON the inner loop (single-threaded there, so no race).
+        self._bridge_ready_async: Optional[asyncio.Event] = None
+        # Set by api.init: called with a failed-send LocalRef so the
+        # cleanup watchdog sees a fatal republish (exit-on-failure
+        # semantics apply to the intra-party bridge too).
+        self.failure_handler = None
 
         if group.num_processes <= 1:
             self._bridge_ready.set()
@@ -263,10 +282,18 @@ class MultiHostTransport:
                     ssl_context=tls_utils.client_ssl_context(self._tls_config),
                 )
             self._bridge_ready.set()
+            inner._loop.call_soon_threadsafe(self._set_ready_on_loop)
 
         threading.Thread(
             target=_connect, name="rayfed-bridge-connect", daemon=True
         ).start()
+
+    def _set_ready_on_loop(self) -> None:
+        # Runs on the inner loop; creates the event if no republish
+        # raced ahead of us.
+        if self._bridge_ready_async is None:
+            self._bridge_ready_async = asyncio.Event()
+        self._bridge_ready_async.set()
 
     def _on_leader_message(self, message) -> None:
         # Runs on the inner loop thread; must not block.
@@ -274,16 +301,28 @@ class MultiHostTransport:
 
     async def _republish(self, message) -> None:
         loop = asyncio.get_running_loop()
-        while not self._bridge_ready.is_set():
-            ok = await loop.run_in_executor(None, self._bridge_ready.wait, 60)
-            if not ok:
-                logger.error(
-                    "bridge clients still unresolved; republish of (%s, %s) "
-                    "waiting", message.upstream_seq_id, message.downstream_seq_id,
-                )
+        if not self._bridge_ready.is_set():
+            if self._bridge_ready_async is None:
+                self._bridge_ready_async = asyncio.Event()
+            while True:
+                try:
+                    await asyncio.wait_for(
+                        self._bridge_ready_async.wait(), timeout=60
+                    )
+                    break
+                except asyncio.TimeoutError:
+                    logger.error(
+                        "bridge clients still unresolved; republish of "
+                        "(%s, %s) waiting",
+                        message.upstream_seq_id, message.downstream_seq_id,
+                    )
         crc = None
         clients = list(self._bridge_clients.items())
-        if clients and clients[0][1].checksum_enabled:
+        if (
+            clients
+            and clients[0][1].checksum_enabled
+            and message.error is None
+        ):
             # One off-loop checksum, reused for every non-leader (the
             # inline per-send path would recompute it N-1 times ON the
             # event loop).
@@ -295,16 +334,27 @@ class MultiHostTransport:
         for pid, client in clients:
             try:
                 await client.send_data(
-                    [message.payload],
+                    [message.payload] if message.error is None else [],
                     message.upstream_seq_id,
                     message.downstream_seq_id,
                     crc=crc,
+                    error=message.error,
                 )
-            except Exception:
+            except Exception as e:
+                # A failed republish means the non-leader can never see
+                # this value: the SPMD program WILL desync.  Loud path
+                # (module docstring contract): escalate to the cleanup
+                # watchdog (exit-on-failure semantics) instead of letting
+                # the non-leader's recv park until its backstop.
                 logger.exception(
                     "bridge republish to p%d failed (up=%s down=%s)",
                     pid, message.upstream_seq_id, message.downstream_seq_id,
                 )
+                if self.failure_handler is not None:
+                    try:
+                        self.failure_handler(LocalRef.from_value(False), e)
+                    except Exception:  # pragma: no cover
+                        logger.exception("republish failure handler raised")
 
     # -- proxy interface ------------------------------------------------------
 

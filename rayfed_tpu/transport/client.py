@@ -361,8 +361,15 @@ class TransportClient:
         downstream_seq_id: str,
         metadata: Optional[Dict[str, str]] = None,
         crc: Optional[int] = None,
+        error: Optional[Dict[str, str]] = None,
     ) -> str:
-        """Push one DATA message with retry policy; returns the ACK result."""
+        """Push one DATA message with retry policy; returns the ACK result.
+
+        ``error``: poison the rendezvous key instead of delivering data —
+        the consumer's recv raises :class:`~rayfed_tpu.exceptions.RemoteError`
+        (improves on reference ``barriers.py:244-248`` which leaves the
+        consumer parked with no diagnosis).
+        """
         payload_len = wire.payload_nbytes(payload_bufs)
         if payload_len > self._max_message_size:
             raise SendError(
@@ -378,6 +385,8 @@ class TransportClient:
             "down": str(downstream_seq_id),
             "meta": merged_meta,
         }
+        if error is not None:
+            header["err"] = error
         has_lazy = any(isinstance(b, wire.LazyBuffer) for b in payload_bufs)
         crc_trailer = False
         if has_lazy:

@@ -181,6 +181,60 @@ class TransportManager:
 
     # -- send path (SendProxy role) ------------------------------------------
 
+    def _send_poison(
+        self, dest_party: str, upstream_seq_id: Any, downstream_seq_id: Any,
+        exc: BaseException,
+    ) -> LocalRef:
+        """Poison the promised rendezvous key on the consumer side.
+
+        Improves on reference ``barriers.py:244-248`` (send failure →
+        ``False`` + log; the peer's recv parks until its backstop): the
+        consumer's ``fed.get`` raises :class:`RemoteError` within the
+        round-trip time, carrying the producer's exception.
+
+        Returns a LocalRef resolving when the poison delivery finished
+        (True/False) — callers chain the user-visible send result on it so
+        ``wait_sending``/``shutdown`` can't cancel an in-flight poison.
+        """
+        from rayfed_tpu.exceptions import RemoteError
+
+        done = LocalRef()
+        err = RemoteError.from_exception(self._party, exc).to_wire()
+        try:
+            client = self._get_client(dest_party)
+            cf = asyncio.run_coroutine_threadsafe(
+                client.send_data(
+                    [], str(upstream_seq_id), str(downstream_seq_id), error=err
+                ),
+                self._loop,
+            )
+
+            def _poison_done(f):
+                # exception() on a cancelled future (shutdown cancelling
+                # loop tasks) RAISES instead of returning — guard it, or
+                # `done` never resolves and wait_sending hangs forever.
+                e = (
+                    f.exception()
+                    if not f.cancelled()
+                    else asyncio.CancelledError("transport stopped")
+                )
+                if e is not None:
+                    logger.warning(
+                        "[%s] failed to poison (%s, %s) at %s: %r",
+                        self._party, upstream_seq_id, downstream_seq_id,
+                        dest_party, e,
+                    )
+                done.set_result(e is None)
+
+            cf.add_done_callback(_poison_done)
+        except Exception as e:  # pragma: no cover - client construction
+            logger.warning(
+                "[%s] cannot poison (%s, %s) at %s: %r",
+                self._party, upstream_seq_id, downstream_seq_id, dest_party, e,
+            )
+            done.set_result(False)
+        return done
+
     def send(
         self,
         dest_party: str,
@@ -192,7 +246,9 @@ class TransportManager:
 
         Failures are swallowed into ``False`` + a log line (parity:
         ``barriers.py:244-248``); the cleanup watchdog turns persistent
-        failures into process exit when configured.
+        failures into process exit when configured.  Beyond parity, a
+        failed producer task or encode also poisons the promised key on
+        the consumer (see :meth:`_send_poison`).
         """
         out_ref = LocalRef()
         self.stats["send_op_count"] += 1
@@ -235,7 +291,7 @@ class TransportManager:
                         out_ref.set_result(True)
                     except Exception as e:
                         logger.warning(
-                            "[%s] failed to send to %s (up=%s down=%s): %s",
+                            "[%s] failed to send to %s (up=%s down=%s): %r",
                             self._party, dest_party, upstream_seq_id,
                             downstream_seq_id, e,
                         )
@@ -243,19 +299,31 @@ class TransportManager:
 
                 cf.add_done_callback(_done)
             except Exception as e:
-                logger.warning("[%s] failed to encode payload for %s: %s",
+                logger.warning("[%s] failed to encode payload for %s: %r",
                                self._party, dest_party, e)
-                out_ref.set_result(False)
+                poison_ref = self._send_poison(
+                    dest_party, upstream_seq_id, downstream_seq_id, e
+                )
+                # False only after the poison delivery settles — otherwise
+                # shutdown's task-cancel races the in-flight poison send.
+                poison_ref.add_done_callback(
+                    lambda _ref: out_ref.set_result(False)
+                )
 
         if isinstance(data, LocalRef):
             def _on_data(ref: LocalRef) -> None:
                 exc = ref.exception()
                 if exc is not None:
                     logger.warning(
-                        "[%s] upstream task failed; cannot send to %s: %s",
+                        "[%s] upstream task failed; cannot send to %s: %r",
                         self._party, dest_party, exc,
                     )
-                    out_ref.set_result(False)
+                    poison_ref = self._send_poison(
+                        dest_party, upstream_seq_id, downstream_seq_id, exc
+                    )
+                    poison_ref.add_done_callback(
+                        lambda _ref: out_ref.set_result(False)
+                    )
                     return
                 self._codec_pool.submit(_encode_and_send, ref.resolve())
 
@@ -293,6 +361,12 @@ class TransportManager:
                 message: Message = f.result()
             except Exception as e:
                 out_ref.set_exception(e)
+                return
+
+            if message.error is not None:
+                from rayfed_tpu.exceptions import RemoteError
+
+                out_ref.set_exception(RemoteError.from_wire(message.error))
                 return
 
             def _decode():

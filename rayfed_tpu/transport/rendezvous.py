@@ -45,6 +45,10 @@ class Message:
     # Wall time the receiver spent reading the payload off the socket —
     # the honest denominator for receiver-side GB/s.
     read_seconds: float = 0.0
+    # Poison marker: the producer's task/encode failed; dict with
+    # party/type/msg (see exceptions.RemoteError.to_wire).  The recv path
+    # raises instead of decoding.
+    error: Optional[Dict[str, str]] = None
 
 
 class _Entry:

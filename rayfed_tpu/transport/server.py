@@ -381,6 +381,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             payload=payload,
             metadata=header.get("meta", {}),
             read_seconds=read_seconds,
+            error=header.get("err"),
         )
         server.stats["receive_op_count"] += 1
         server.stats["receive_bytes"] += len(payload)

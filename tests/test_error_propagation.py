@@ -1,0 +1,165 @@
+"""Cross-party error propagation tests.
+
+SURVEY §7 sets "replicate, then improve (surfacing errors on ``get``)"
+against the reference's swallow-into-False behavior
+(``fed/barriers.py:244-248``).  These tests pin the improvement: a failed
+producer task poisons every rendezvous key it promised, and the consumer's
+``fed.get`` raises :class:`rayfed_tpu.RemoteError` within the transport
+round-trip time — not the recv backstop.
+"""
+
+import time
+
+import pytest
+
+from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+from rayfed_tpu.exceptions import RemoteError
+from rayfed_tpu.executor import LocalRef
+from rayfed_tpu.transport.manager import TransportManager
+from tests.multiproc import get_free_ports, make_cluster, run_parties
+
+CLUSTER_AB = make_cluster(["alice", "bob"])
+
+
+# --- transport-level: poison rides the wire ---------------------------------
+
+
+def _self_cluster(party="alice"):
+    (port,) = get_free_ports(1)
+    return ClusterConfig(
+        parties={party: PartyConfig(address=f"127.0.0.1:{port}")},
+        current_party=party,
+    )
+
+
+@pytest.fixture()
+def manager():
+    mgr = TransportManager(
+        _self_cluster(), JobConfig(device_put_received=False, recv_backstop_s=120)
+    )
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def test_failed_upstream_poisons_recv(manager):
+    """A send whose upstream LocalRef failed resolves the matching recv
+    with RemoteError instead of leaving it parked until the backstop."""
+    recv_ref = manager.recv("alice", "9#0", "11")
+    failed = LocalRef()
+    failed.set_exception(ValueError("boom-upstream"))
+    send_ref = manager.send("alice", failed, "9#0", "11")
+    # Parity: the send result itself is still False (ref barriers.py:244-248).
+    assert send_ref.resolve(timeout=30) is False
+    t0 = time.monotonic()
+    with pytest.raises(RemoteError) as ei:
+        recv_ref.resolve(timeout=30)
+    assert time.monotonic() - t0 < 10
+    assert ei.value.exc_type == "ValueError"
+    assert "boom-upstream" in ei.value.message
+    assert ei.value.party == "alice"
+
+
+def test_failed_encode_poisons_recv(manager):
+    """An encode failure (unpicklable payload) also poisons the key."""
+    recv_ref = manager.recv("alice", "21#0", "23")
+
+    class Unpicklable:
+        def __reduce__(self):
+            raise TypeError("cannot pickle me")
+
+    send_ref = manager.send("alice", Unpicklable(), "21#0", "23")
+    assert send_ref.resolve(timeout=30) is False
+    with pytest.raises(RemoteError) as ei:
+        recv_ref.resolve(timeout=30)
+    assert "cannot pickle me" in ei.value.message
+
+
+def test_remote_error_wire_roundtrip():
+    err = RemoteError.from_exception("alice", ValueError("x" * 10))
+    back = RemoteError.from_wire(err.to_wire())
+    assert back.party == "alice"
+    assert back.exc_type == "ValueError"
+    assert back.message == "x" * 10
+
+
+# --- end-to-end: producer raises, consumer's fed.get raises -----------------
+
+
+def run_producer_raises(party, cluster):
+    import rayfed_tpu as fed
+
+    fed.init(
+        address="local",
+        cluster=cluster,
+        party=party,
+        recv_backstop_in_seconds=120,
+    )
+
+    @fed.remote
+    def boom():
+        raise ValueError("boom-42")
+
+    @fed.remote
+    def consume(x):
+        return x + 1
+
+    obj = boom.party("alice").remote()
+    out = consume.party("bob").remote(obj)
+    t0 = time.monotonic()
+    try:
+        fed.get(out)
+        raise AssertionError("fed.get should have raised")
+    except fed.RemoteError as e:
+        # Within the transport round trip — nowhere near the 120s backstop.
+        assert time.monotonic() - t0 < 20, time.monotonic() - t0
+        assert "boom-42" in str(e)
+        # bob sees alice's original failure; alice sees bob's failed
+        # consume result (which nests alice's error).
+        if party == "bob":
+            assert e.exc_type == "ValueError"
+            assert e.party == "alice"
+    fed.shutdown()
+
+
+def test_producer_failure_surfaces_on_get():
+    run_parties(run_producer_raises, ["alice", "bob"], args=(CLUSTER_AB,))
+
+
+def run_actor_method_raises(party, cluster):
+    import rayfed_tpu as fed
+
+    fed.init(
+        address="local",
+        cluster=cluster,
+        party=party,
+        recv_backstop_in_seconds=120,
+    )
+
+    @fed.remote
+    class Worker:
+        def work(self):
+            raise RuntimeError("actor-boom")
+
+    w = Worker.party("alice").remote()
+    out = w.work.remote()
+    t0 = time.monotonic()
+    if party == "alice":
+        try:
+            fed.get(out)
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError as e:
+            assert "actor-boom" in str(e)
+    else:
+        try:
+            fed.get(out)
+            raise AssertionError("expected RemoteError")
+        except fed.RemoteError as e:
+            assert time.monotonic() - t0 < 20
+            assert "actor-boom" in str(e)
+            assert e.party == "alice"
+    fed.shutdown()
+
+
+def test_actor_failure_surfaces_on_get():
+    run_parties(run_actor_method_raises, ["alice", "bob"], args=(CLUSTER_AB,))
