@@ -52,23 +52,16 @@ PARTITION_RULES = (
 )
 
 
-def apply_moe(
-    params: Params,
-    x: jax.Array,
-    config: MoeConfig,
-    *,
-    return_aux: bool = False,
-):
-    """[B, T, d] → [B, T, d] with top-k expert routing.
+# Above this many elements, the einsum path's [B,T,k,E,C] one-hot mask is
+# a memory/FLOP blowup (tens of GB at T=8192, E=64) — refuse it and point
+# at the scatter path, which is the default.
+_EINSUM_DISPATCH_MAX_ELEMENTS = 1 << 30
 
-    Static-shape dispatch: every expert processes a fixed capacity
-    ``C = ceil(k·T·cf / E)`` tokens per batch row; overflow tokens are
-    dropped (standard Switch behavior) and their output falls back to 0
-    for that expert slot (residual connections outside absorb this).
-    """
-    b, t, d = x.shape
+
+def _route(params: Params, x: jax.Array, config: MoeConfig):
+    """Shared top-k routing: gate values, expert ids, capacity ranks."""
+    b, t, _ = x.shape
     e, k = config.num_experts, config.top_k
-    capacity = max(1, math.ceil(config.capacity_factor * k * t / e))
 
     logits = x @ params["gate"].astype(x.dtype)  # [B, T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -80,34 +73,110 @@ def apply_moe(
         gate_vals.sum(axis=-1, keepdims=True), 1e-9
     )
 
-    # Position of each (token, choice) within its expert's capacity buffer.
+    # Position of each (token, choice) within its expert's capacity
+    # buffer: 0-based rank in (t, k)-lexicographic priority order —
+    # equivalent to a stable sort of assignments by expert id.
     onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [B, T, k, E]
     flat = onehot.reshape(b, t * k, e)
     pos_in_expert = jnp.cumsum(flat, axis=1) * flat  # 1-based rank
     pos_in_expert = pos_in_expert.reshape(b, t, k, e) - 1
+    return probs, gate_vals, expert_idx, onehot, pos_in_expert
+
+
+def _expert_ffn(params: Params, expert_in: jax.Array) -> jax.Array:
+    """[B, E, C, d] → [B, E, C, d]; E is a batched matmul dim on the MXU."""
+    h = jax.nn.gelu(
+        jnp.einsum(
+            "becd,edf->becf", expert_in, params["w_in"].astype(expert_in.dtype)
+        )
+    )
+    return jnp.einsum(
+        "becf,efd->becd", h, params["w_out"].astype(expert_in.dtype)
+    )
+
+
+def apply_moe(
+    params: Params,
+    x: jax.Array,
+    config: MoeConfig,
+    *,
+    return_aux: bool = False,
+    dispatch: str = "scatter",
+):
+    """[B, T, d] → [B, T, d] with top-k expert routing.
+
+    Static-shape dispatch: every expert processes a fixed capacity
+    ``C = ceil(k·T·cf / E)`` tokens per batch row; overflow tokens are
+    dropped (standard Switch behavior) and their output falls back to 0
+    for that expert slot (residual connections outside absorb this).
+
+    ``dispatch``:
+
+    - ``"scatter"`` (default): rank-sorted sparse dispatch — tokens
+      scatter into ``[B, E, C, d]`` expert buffers (out-of-capacity
+      assignments drop in the scatter itself) and combine is a gather.
+      O(B·T·k·d) routing work; peak routing memory is the buffers.
+    - ``"einsum"``: the GShard-style one-hot ``[B, T, k, E, C]`` mask
+      einsum.  O(B·T·E·C·d) dispatch FLOPs and a mask that reaches tens
+      of GB at production shapes (T=8192, E=64) — kept as the reference
+      implementation for numerics tests at small shapes; guarded above
+      ``_EINSUM_DISPATCH_MAX_ELEMENTS``.
+
+    Both paths share routing, so they agree exactly (tested in
+    ``tests/test_moe.py``).
+    """
+    b, t, d = x.shape
+    e, k = config.num_experts, config.top_k
+    capacity = max(1, math.ceil(config.capacity_factor * k * t / e))
+
+    probs, gate_vals, expert_idx, onehot, pos_in_expert = _route(
+        params, x, config
+    )
     keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
 
-    # Dispatch mask [B, T, k, E, C] — one-hot over capacity slots.
-    pos_clamped = jnp.clip(pos_in_expert, 0, capacity - 1)
-    dispatch = (
-        jax.nn.one_hot(pos_clamped, capacity, dtype=x.dtype)
-        * keep[..., None].astype(x.dtype)
-        * onehot[..., None].astype(x.dtype)
-    )  # [B, T, k, E, C]
-    dispatch_tok = dispatch.sum(axis=2)  # [B, T, E, C]
-    combine = (
-        dispatch * gate_vals[..., None, None].astype(x.dtype)
-    ).sum(axis=2)  # [B, T, E, C]
-
-    # Route tokens to expert buffers: [B, E, C, d].
-    expert_in = jnp.einsum("btec,btd->becd", dispatch_tok, x)
-    # Expert FFN (stacked weights; E is a batched matmul dim on the MXU).
-    h = jax.nn.gelu(
-        jnp.einsum("becd,edf->becf", expert_in, params["w_in"].astype(x.dtype))
-    )
-    expert_out = jnp.einsum("becf,efd->becd", h, params["w_out"].astype(x.dtype))
-    # Combine back, weighted by gate values.
-    out = jnp.einsum("btec,becd->btd", combine, expert_out)
+    if dispatch == "scatter":
+        # Per-assignment expert rank: [B, T, k] (rank under ITS expert).
+        pos_assign = jnp.max(pos_in_expert * onehot, axis=-1)
+        bidx = jnp.arange(b)[:, None, None]  # [B, 1, 1] broadcasts to [B,T,k]
+        # Scatter tokens into capacity buffers; ranks >= C fall outside
+        # the buffer and XLA's "drop" mode discards them — the capacity
+        # discipline costs no mask tensor at all.
+        expert_in = jnp.zeros((b, e, capacity, d), x.dtype)
+        x_rep = jnp.broadcast_to(x[:, :, None, :], (b, t, k, d))
+        expert_in = expert_in.at[bidx, expert_idx, pos_assign].add(
+            x_rep, mode="drop"
+        )
+        expert_out = _expert_ffn(params, expert_in)
+        # Combine: gather each assignment's output back (dropped ranks
+        # gather fill=0), weight by its gate value, sum over k.
+        gathered = expert_out.at[bidx, expert_idx, pos_assign].get(
+            mode="fill", fill_value=0
+        )  # [B, T, k, d]
+        out = jnp.sum(gathered * gate_vals[..., None].astype(x.dtype), axis=2)
+    elif dispatch == "einsum":
+        mask_elements = b * t * k * e * capacity
+        if mask_elements > _EINSUM_DISPATCH_MAX_ELEMENTS:
+            raise ValueError(
+                f"einsum dispatch mask would hold {mask_elements} elements "
+                f"([B={b}, T={t}, k={k}, E={e}, C={capacity}]); use "
+                f'dispatch="scatter" at this scale'
+            )
+        # Dispatch mask [B, T, k, E, C] — one-hot over capacity slots.
+        pos_clamped = jnp.clip(pos_in_expert, 0, capacity - 1)
+        dispatch_mask = (
+            jax.nn.one_hot(pos_clamped, capacity, dtype=x.dtype)
+            * keep[..., None].astype(x.dtype)
+            * onehot[..., None].astype(x.dtype)
+        )  # [B, T, k, E, C]
+        dispatch_tok = dispatch_mask.sum(axis=2)  # [B, T, E, C]
+        combine = (
+            dispatch_mask * gate_vals[..., None, None].astype(x.dtype)
+        ).sum(axis=2)  # [B, T, E, C]
+        expert_in = jnp.einsum("btec,btd->becd", dispatch_tok, x)
+        expert_out = _expert_ffn(params, expert_in)
+        out = jnp.einsum("btec,becd->btd", combine, expert_out)
+    else:
+        raise ValueError(f"unknown dispatch mode {dispatch!r}")
 
     if not return_aux:
         return out
