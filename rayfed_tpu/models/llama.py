@@ -135,6 +135,95 @@ def init_llama(key: jax.Array, config: LlamaConfig) -> Params:
     return params
 
 
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_llama_base(params: Params) -> Params:
+    """int8-quantize the frozen base for a LoRA fine-tune.
+
+    The seven stacked [L, din, dout] matmul weights get per-(layer,
+    output-channel) scales; ``lm_head`` a per-column scale; embeddings
+    and norms stay in their float dtype (gathered/elementwise — no MXU
+    matmul to fuse a dequant into).  Halves the bf16 footprint again:
+    Llama-3-8B base ≈ 8 GB, fitting a 16 GB v5e chip with adapters +
+    Adam moments to spare (BASELINE.json config #4 at literal scale).
+    Use with :func:`make_lora_train_step` only — the base must stay
+    frozen (int8 leaves carry no gradient).
+    """
+    from rayfed_tpu.models.quant import quantize_int8
+
+    out = dict(params)
+    out["layers"] = {
+        k: (
+            quantize_int8(v, channel_axis=-1, batch_axes=(0,))
+            if k in _QUANT_LEAVES
+            else v
+        )
+        for k, v in params["layers"].items()
+    }
+    if "lm_head" in params:
+        out["lm_head"] = quantize_int8(params["lm_head"], channel_axis=-1)
+    return out
+
+
+def init_llama_int8(key: jax.Array, config: LlamaConfig) -> Params:
+    """Random int8-quantized base, built WITHOUT a full-precision pass.
+
+    Each matmul weight is generated directly as int8 (uniform in
+    [-127, 127]) with a fan-in-scaled per-channel dequant scale, so peak
+    memory during init is the int8 tree itself — at 8B the bf16
+    intermediate that ``init_llama`` + :func:`quantize_llama_base` would
+    build (~16 GB) never exists.  For benches and scaffolding; real runs
+    load quantized checkpoints.
+    """
+    from rayfed_tpu.models.quant import QTensor
+
+    d = config.hidden_size
+    dh = config.head_dim
+    h, kv = config.num_heads, config.num_kv_heads
+    f = config.intermediate_size
+    L = config.num_layers
+    pdt = config.param_dtype
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def qdense(key, *shape, fan_in):
+        q = jax.random.randint(key, shape, -127, 128, dtype=jnp.int8)
+        # E[q^2] ≈ 127^2/3 ⇒ scale for unit-ish activations: 1/(73·√fan_in).
+        scale_shape = (shape[0], *([1] * (len(shape) - 2)), shape[-1])
+        scale = jnp.full(scale_shape, (fan_in**-0.5) / 73.0, jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    lk = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": (
+            jax.random.normal(k_embed, (config.vocab_size, d), pdt) * 0.02 * d**0.5
+        ),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), pdt),
+            "wq": qdense(lk[0], L, d, h * dh, fan_in=d),
+            "wk": qdense(lk[1], L, d, kv * dh, fan_in=d),
+            "wv": qdense(lk[2], L, d, kv * dh, fan_in=d),
+            "wo": qdense(lk[3], L, h * dh, d, fan_in=h * dh),
+            "mlp_norm": jnp.ones((L, d), pdt),
+            "w_gate": qdense(lk[4], L, d, f, fan_in=d),
+            "w_up": qdense(lk[5], L, d, f, fan_in=d),
+            "w_down": qdense(lk[6], L, f, d, fan_in=f),
+        },
+        "final_norm": jnp.ones((d,), pdt),
+    }
+    if not config.tie_embeddings:
+        head = jax.random.randint(
+            k_head, (d, config.vocab_size), -127, 128, dtype=jnp.int8
+        )
+        from rayfed_tpu.models.quant import QTensor as _QT
+
+        params["lm_head"] = _QT(
+            q=head,
+            scale=jnp.full((1, config.vocab_size), (d**-0.5) / 73.0, jnp.float32),
+        )
+    return params
+
+
 def _rms_norm(x, scale, eps):
     xf = x.astype(jnp.float32)
     norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -160,8 +249,13 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _linear(x, w, lora_entry, dtype):
-    """x @ w with an optional LoRA low-rank bypass (x@A)@B · scale."""
-    out = x @ w.astype(dtype)
+    """x @ w with an optional LoRA low-rank bypass (x@A)@B · scale.
+
+    ``w`` may be an int8 :class:`~rayfed_tpu.models.quant.QTensor` (frozen
+    base in a LoRA fine-tune); its dequant fuses into the matmul."""
+    from rayfed_tpu.models.quant import as_weight
+
+    out = x @ as_weight(w, dtype)
     if lora_entry is not None:
         a = lora_entry["a"].astype(dtype)
         b = lora_entry["b"].astype(dtype)
@@ -228,13 +322,18 @@ def _lm_head(x, params, config):
     at a fraction of bf16 throughput and the f32 accumulator already
     carries the precision the loss needs.
     """
+    from rayfed_tpu.models.quant import as_weight
+
     x = _rms_norm(x, params["final_norm"], config.rms_eps)
     head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
+    head = (
+        params["embed"].astype(config.dtype).T
+        if head is None
+        else as_weight(head, config.dtype)
+    )
     return jax.lax.dot_general(
         x.astype(config.dtype),
-        head.astype(config.dtype),
+        head,
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -667,6 +766,42 @@ def make_train_loop(
             body, (params, opt), None, length=num_steps
         )
         return params, opt, losses
+
+    return jax.jit(run, donate_argnums=(0, 1))
+
+
+def make_lora_train_loop(
+    config: LlamaConfig,
+    num_steps: int,
+    lr: float = 1e-4,
+    *,
+    attn_fn: Callable = dot_product_attention,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+):
+    """N LoRA Adam steps in ONE compiled program (lax.scan).
+
+    (lora, opt, base_params, ids) → (lora, opt, losses[num_steps]); base
+    stays frozen (may be int8-quantized, see :func:`quantize_llama_base`).
+    Same one-dispatch rationale as :func:`make_train_loop`.
+    """
+
+    def loss_fn(lora, base_params, ids):
+        logits = apply_llama(base_params, ids, config, lora=lora, attn_fn=attn_fn)
+        return lm_loss(logits[:, :-1], ids[:, 1:])
+
+    def run(lora, opt, base_params, ids):
+        def body(carry, _):
+            lora, opt = carry
+            loss, grads = jax.value_and_grad(loss_fn)(lora, base_params, ids)
+            lora, opt = _adam_update(lora, grads, opt, lr, b1, b2, eps)
+            return (lora, opt), loss
+
+        (lora, opt), losses = jax.lax.scan(
+            body, (lora, opt), None, length=num_steps
+        )
+        return lora, opt, losses
 
     return jax.jit(run, donate_argnums=(0, 1))
 

@@ -57,8 +57,14 @@ def init_lora(key: jax.Array, params: Params, config: LoraConfig) -> Params:
     only matched leaves, each replaced by ``{"a", "b", "scale"}``.
     A is gaussian-init, B zero-init (adapter starts as identity).
     """
+    from rayfed_tpu.models.quant import QTensor
+
     compiled = [re.compile(pat) for pat in config.targets]
-    leaves = jax.tree_util.tree_leaves_with_path(params)
+    # QTensors are leaves here: the adapter mirrors the LOGICAL weight
+    # (its int8 q + scale children must not split the path match).
+    leaves = jax.tree_util.tree_leaves_with_path(
+        params, is_leaf=lambda x: isinstance(x, QTensor)
+    )
     out: Params = {}
     for path, leaf in leaves:
         path_s = _path_str(path)
@@ -94,6 +100,14 @@ def merge_lora(params: Params, lora: Params) -> Params:
 
     def _merge(base_node, lora_node):
         if isinstance(lora_node, dict) and set(lora_node) == {"a", "b", "scale"}:
+            from rayfed_tpu.models.quant import QTensor
+
+            if isinstance(base_node, QTensor):
+                raise TypeError(
+                    "cannot merge LoRA into an int8-quantized base; "
+                    "dequantize first (QTensor.dequantize) or keep the "
+                    "adapter separate"
+                )
             return (base_node + lora_delta(lora_node)).astype(base_node.dtype)
         if isinstance(lora_node, dict):
             return {

@@ -1,0 +1,128 @@
+"""Weight-only int8 quantization for frozen base models.
+
+TPU-first rationale: a LoRA fine-tune never updates the base weights, so
+they can live in HBM as int8 with a per-output-channel scale — halving
+bf16's footprint again and fitting Llama-3-8B (+adapters +Adam moments)
+on one 16 GB v5e chip.  Dequantization (``int8 → bf16 × scale``) fuses
+into the consuming matmul under XLA, so the MXU still sees bf16 operands;
+there is no custom kernel to maintain.
+
+Absent from the reference (it ships no model layer at all, SURVEY §1);
+this supports BASELINE.json config #4 at its literal 8B scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """An int8 weight plus its per-output-channel dequantization scale.
+
+    ``q``: int8, the stored weight.  ``scale``: broadcastable to ``q``'s
+    shape (per-channel: size-1 on every axis except the channel axis).
+    Logical value: ``q * scale``.
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # storage dtype; dequantized dtype is the caller's
+        return self.q.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size * 1 + self.scale.size * self.scale.dtype.itemsize
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+
+def quantize_int8(
+    w: jax.Array, *, channel_axis: int = -1, batch_axes: tuple = ()
+) -> QTensor:
+    """Symmetric per-channel int8 quantization.
+
+    ``channel_axis``: the output-feature axis whose scale is kept
+    per-channel.  ``batch_axes``: additional axes that keep their own
+    scale (e.g. the stacked-layer axis 0 of a scanned [L, din, dout]
+    weight — without it all layers would share one scale).  Max-abs
+    scaling: values map onto [-127, 127] with zero preserved exactly.
+    """
+    keep = {channel_axis % w.ndim} | {a % w.ndim for a in batch_axes}
+    axes = tuple(i for i in range(w.ndim) if i not in keep)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QTensor(q=q.astype(jnp.int8), scale=scale.astype(jnp.float32))
+
+
+def as_weight(w: Any, dtype) -> jax.Array:
+    """Materialize a weight leaf for a matmul: dequantize QTensors, cast
+    everything else.  The dequant fuses into the consuming dot under jit."""
+    if isinstance(w, QTensor):
+        return w.dequantize(dtype)
+    return w.astype(dtype)
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, QTensor)
+
+
+def quantize_tree(
+    params: Any,
+    *,
+    predicate: Optional[Callable[[str, jax.Array], bool]] = None,
+    channel_axis: int = -1,
+) -> Any:
+    """Quantize matching array leaves of a param pytree to :class:`QTensor`.
+
+    ``predicate(path_str, leaf) -> bool`` selects leaves (default: every
+    float leaf with ndim >= 2 — matmul weights; norms/biases stay as-is).
+    """
+
+    def _default(_path: str, leaf: jax.Array) -> bool:
+        return leaf.ndim >= 2 and jnp.issubdtype(leaf.dtype, jnp.floating)
+
+    pred = predicate or _default
+
+    def _maybe(path, leaf):
+        path_str = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        if isinstance(leaf, jax.Array) and pred(path_str, leaf):
+            return quantize_int8(leaf, channel_axis=channel_axis)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(_maybe, params)
+
+
+def tree_nbytes(params: Any) -> int:
+    """Storage bytes of a (possibly quantized) param tree."""
+    return sum(
+        leaf.nbytes
+        for leaf in jax.tree_util.tree_leaves(params)
+        if hasattr(leaf, "nbytes")
+    )

@@ -1,0 +1,122 @@
+"""int8 weight-only quantization: roundtrip accuracy + frozen-base LoRA.
+
+Supports BASELINE.json config #4 at literal 8B scale (int8 base + bf16
+LoRA fits one 16 GB chip); these tests pin the numerics at small shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.models import llama, lora
+from rayfed_tpu.models.quant import (
+    QTensor,
+    as_weight,
+    quantize_int8,
+    quantize_tree,
+    tree_nbytes,
+)
+
+
+def test_quantize_roundtrip_error_small():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.05
+    qt = quantize_int8(w)
+    back = qt.dequantize()
+    # Per-channel max-abs int8: worst-case error is scale/2 per entry.
+    max_err = float(jnp.max(jnp.abs(back - w)))
+    assert max_err <= float(jnp.max(qt.scale)) / 2 + 1e-7
+    # Matmul through the quantized weight stays close (error accumulates
+    # over fan_in=64 terms; bound relative to the output magnitude).
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 64))
+    ref = x @ w
+    np.testing.assert_allclose(
+        x @ back, ref, atol=2e-2 * float(jnp.max(jnp.abs(ref)))
+    )
+
+
+def test_quantize_batch_axes_per_layer_scales():
+    """Stacked [L, din, dout] weights get per-(layer, channel) scales."""
+    w = jnp.stack(
+        [
+            jax.random.normal(jax.random.PRNGKey(i), (16, 8)) * (0.01 * (i + 1))
+            for i in range(4)
+        ]
+    )
+    qt = quantize_int8(w, channel_axis=-1, batch_axes=(0,))
+    assert qt.scale.shape == (4, 1, 8)
+    # Layer 3's weights are 4x layer 0's; shared scales would clip one of
+    # them — per-layer scales keep both accurate.
+    back = qt.dequantize()
+    for layer in range(4):
+        rel = float(
+            jnp.max(jnp.abs(back[layer] - w[layer])) / jnp.max(jnp.abs(w[layer]))
+        )
+        assert rel < 0.01, (layer, rel)
+
+
+def test_quantize_tree_skips_norms_and_vectors():
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (8, 8)),
+        "norm": jnp.ones((8,)),
+    }
+    qp = quantize_tree(params)
+    assert isinstance(qp["w"], QTensor)
+    assert not isinstance(qp["norm"], QTensor)
+    assert tree_nbytes(qp) < tree_nbytes(params)
+
+
+def test_llama_quantized_base_forward_close():
+    cfg = llama.llama_tiny()
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    qparams = llama.quantize_llama_base(params)
+    # int8 layers + lm_head ≈ quarter the f32 storage.
+    assert tree_nbytes(qparams) < 0.45 * tree_nbytes(params)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = llama.apply_llama(params, ids, cfg)
+    qlogits = llama.apply_llama(qparams, ids, cfg)
+    assert qlogits.shape == logits.shape
+    # Weight-only int8 keeps logits close in relative terms.
+    scale = float(jnp.max(jnp.abs(logits))) + 1e-6
+    assert float(jnp.max(jnp.abs(qlogits - logits))) / scale < 0.1
+
+
+def test_lora_train_step_on_int8_base():
+    """Adapters init + train on a quantized base; loss decreases, base
+    stays untouched (int8 leaves carry no gradient)."""
+    cfg = llama.llama_tiny()
+    base = llama.quantize_llama_base(llama.init_llama(jax.random.PRNGKey(0), cfg))
+    lcfg = lora.LoraConfig(rank=4, targets=(r"w[qv]$",))
+    adapters = lora.init_lora(jax.random.PRNGKey(1), base, lcfg)
+    # Targets matched through QTensor leaves (path regex sees the weight).
+    assert "wq" in adapters["layers"] and "wv" in adapters["layers"]
+    opt = llama.init_adam(adapters)
+    step = llama.make_lora_train_step(cfg, lr=1e-2)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, cfg.vocab_size)
+    _, _, loss0 = step(adapters, opt, base, ids)
+    adapters2, opt, loss = step(adapters, opt, base, ids)
+    for _ in range(5):
+        adapters2, opt, loss = step(adapters2, opt, base, ids)
+    assert float(loss) < float(loss0)
+    assert np.isfinite(float(loss))
+
+
+def test_init_llama_int8_shapes_and_forward():
+    cfg = llama.llama_tiny()
+    params = llama.init_llama_int8(jax.random.PRNGKey(0), cfg)
+    assert isinstance(params["layers"]["wq"], QTensor)
+    assert params["layers"]["wq"].q.dtype == jnp.int8
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = llama.apply_llama(params, ids, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.all(np.isfinite(np.asarray(logits, np.float32)))
+
+
+def test_merge_lora_rejects_quantized_base():
+    cfg = llama.llama_tiny()
+    base = llama.quantize_llama_base(llama.init_llama(jax.random.PRNGKey(0), cfg))
+    adapters = lora.init_lora(
+        jax.random.PRNGKey(1), base, lora.LoraConfig(rank=2)
+    )
+    with pytest.raises(TypeError, match="quantized"):
+        lora.merge_lora(base, adapters)
