@@ -11,7 +11,15 @@ Here the common algorithms ship with the framework:
   gradients pushed back (BASELINE.md config #5).
 """
 
+from rayfed_tpu.fl.compression import compress, decompress
 from rayfed_tpu.fl.fedavg import aggregate, tree_average, tree_weighted_sum
 from rayfed_tpu.fl.split import SplitTrainer
 
-__all__ = ["aggregate", "tree_average", "tree_weighted_sum", "SplitTrainer"]
+__all__ = [
+    "aggregate",
+    "tree_average",
+    "tree_weighted_sum",
+    "SplitTrainer",
+    "compress",
+    "decompress",
+]

@@ -1,0 +1,43 @@
+"""Wire compression for federated exchanges.
+
+Cross-party pushes ride DCN; at ResNet/Llama scale the parameter payload
+is the round's dominant wire cost.  Casting float leaves to bfloat16 for
+the wire halves the bytes with ~3 decimal digits kept — the standard FL
+compression baseline (more aggressive schemes — top-k sparsification,
+int8 — trade convergence; bf16 is numerically safe for parameter
+averaging when the accumulate runs in f32, which
+:func:`rayfed_tpu.fl.tree_average` does).
+
+Usage (each side of the exchange):
+
+    push:     fed_obj = train.remote(...)  # task returns compress(tree)
+    consume:  params = decompress(fed.get(obj), jnp.float32)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree: Any, dtype) -> Any:
+    """Cast every floating leaf to ``dtype`` (ints/bools untouched)."""
+
+    def _cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def compress(tree: Any) -> Any:
+    """bf16 wire form of a float param tree (half the push bytes)."""
+    return cast_floats(tree, jnp.bfloat16)
+
+
+def decompress(tree: Any, dtype=jnp.float32) -> Any:
+    """Restore a wire-compressed tree to the compute dtype."""
+    return cast_floats(tree, dtype)

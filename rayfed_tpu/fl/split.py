@@ -103,17 +103,20 @@ class _EncoderActor(_SplitHalf):
     accumulated gradient once (GPipe-style accumulate-then-apply).
     """
 
-    def __init__(self, params: Any, apply_fn: Callable, lr: float):
+    def __init__(
+        self, params: Any, apply_fn: Callable, lr: float, wire_dtype=None
+    ):
         self._params = params
         self._saved: Dict[int, Any] = {}
         self._accum = _GradAccum(lr)
 
         def _fwd(params, x):
-            return apply_fn(params, x)
+            h = apply_fn(params, x)
+            return h.astype(wire_dtype) if wire_dtype is not None else h
 
         def _grads(params, x, g):
-            _, vjp = jax.vjp(lambda p: apply_fn(p, x), params)
-            (grads,) = vjp(g)
+            out, vjp = jax.vjp(lambda p: apply_fn(p, x), params)
+            (grads,) = vjp(g.astype(out.dtype))
             return grads
 
         self._fwd = jax.jit(_fwd)
@@ -136,15 +139,28 @@ class _EncoderActor(_SplitHalf):
 class _HeadActor(_SplitHalf):
     """Party-local head half: loss + grads for both head and activations."""
 
-    def __init__(self, params: Any, apply_fn: Callable, loss_fn: Callable, lr: float):
+    def __init__(
+        self,
+        params: Any,
+        apply_fn: Callable,
+        loss_fn: Callable,
+        lr: float,
+        wire_dtype=None,
+    ):
         self._params = params
         self._accum = _GradAccum(lr)
 
         def _grads(params, h, y):
+            # Wire-compressed activations compute in f32; the activation
+            # gradient goes back to the wire in the compressed dtype.
+            hc = h.astype(jax.numpy.float32) if wire_dtype is not None else h
+
             def f(params, h):
                 return loss_fn(apply_fn(params, h), y)
 
-            loss, (g_params, g_h) = jax.value_and_grad(f, argnums=(0, 1))(params, h)
+            loss, (g_params, g_h) = jax.value_and_grad(f, argnums=(0, 1))(params, hc)
+            if wire_dtype is not None:
+                g_h = g_h.astype(wire_dtype)
             return g_params, g_h, loss
 
         self._grads = jax.jit(_grads)
@@ -169,6 +185,11 @@ class SplitTrainer:
     Call from the shared (multi-controller) program *after* ``fed.init``.
     ``encoder_apply(params, x) -> activations``;
     ``head_apply(params, h) -> logits``; ``loss_fn(logits, y) -> scalar``.
+
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``): cast activations and their
+    gradients to this dtype for the cross-silo hop — half the wire bytes
+    per step; the head upcasts to f32 for its compute.  Standard split-FL
+    activation compression; leave ``None`` for exact f32 exchange.
     """
 
     def __init__(
@@ -182,6 +203,7 @@ class SplitTrainer:
         head_apply: Callable,
         loss_fn: Callable,
         lr: float = 0.1,
+        wire_dtype=None,
     ):
         import rayfed_tpu as fed
 
@@ -189,12 +211,12 @@ class SplitTrainer:
         self._encoder = (
             fed.remote(_EncoderActor)
             .party(encoder_party)
-            .remote(encoder_params, encoder_apply, lr)
+            .remote(encoder_params, encoder_apply, lr, wire_dtype)
         )
         self._head = (
             fed.remote(_HeadActor)
             .party(head_party)
-            .remote(head_params, head_apply, loss_fn, lr)
+            .remote(head_params, head_apply, loss_fn, lr, wire_dtype)
         )
 
     def step(self, x_obj, y_obj):

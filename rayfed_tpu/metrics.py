@@ -41,6 +41,7 @@ class TransferLog:
     def __init__(self, capacity: int = 1024) -> None:
         self._records: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._total = 0  # monotonic count of all records ever appended
 
     def record(self, direction, peer, up_id, down_id, nbytes, seconds) -> None:
         with self._lock:
@@ -48,10 +49,32 @@ class TransferLog:
                 TransferRecord(direction, peer, str(up_id), str(down_id),
                                int(nbytes), float(seconds))
             )
+            self._total += 1
 
     def records(self):
         with self._lock:
             return list(self._records)
+
+    @property
+    def total_recorded(self) -> int:
+        """Monotonic append count — unlike ``len(records())``, never
+        capped by the ring, so windows can be delimited correctly."""
+        with self._lock:
+            return self._total
+
+    def records_since(self, total_before: int):
+        """(records appended after the ``total_recorded`` snapshot,
+        complete_flag).  ``complete_flag`` is False when the ring evicted
+        part of the window — callers must not present a partial window
+        as a full decomposition."""
+        with self._lock:
+            delta = self._total - total_before
+            recs = list(self._records)
+        if delta <= 0:
+            return [], True
+        if delta > len(recs):
+            return recs, False
+        return recs[-delta:], True
 
     def throughput_gbps(self, direction: Optional[str] = None) -> float:
         recs = [

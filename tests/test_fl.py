@@ -25,6 +25,34 @@ def test_tree_average_weighted():
     np.testing.assert_allclose(s["w"], [7.5])
 
 
+def test_tree_average_bf16_accumulates_f32():
+    """bf16 wire-compressed contributions average without bf16 rounding
+    of the accumulator; result keeps the input dtype."""
+    trees = [
+        {"w": jnp.full((8,), 1.0 + i * 1e-2, jnp.bfloat16)} for i in range(4)
+    ]
+    avg = tree_average(trees)
+    assert avg["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(avg["w"], np.float32), 1.015, rtol=1e-2
+    )
+
+
+def test_compress_roundtrip():
+    from rayfed_tpu.fl import compress, decompress
+
+    tree = {
+        "w": jnp.arange(8, dtype=jnp.float32) / 7.0,
+        "step": jnp.array(3, jnp.int32),
+    }
+    wire = compress(tree)
+    assert wire["w"].dtype == jnp.bfloat16
+    assert wire["step"].dtype == jnp.int32  # ints untouched
+    back = decompress(wire)
+    assert back["w"].dtype == jnp.float32
+    np.testing.assert_allclose(back["w"], tree["w"], atol=4e-3)
+
+
 FEDAVG_CLUSTER = make_cluster(["alice", "bob"])
 
 
@@ -73,6 +101,61 @@ def run_fedavg_mnist(party, cluster=FEDAVG_CLUSTER):
 
 def test_fedavg_two_party():
     run_parties(run_fedavg_mnist, ["alice", "bob"], args=(FEDAVG_CLUSTER,))
+
+
+LAZY_CLUSTER = make_cluster(["alice", "bob", "carol"])
+
+
+def run_fedavg_lazy(party, cluster=LAZY_CLUSTER):
+    """Pipelined rounds: aggregate(materialize=False) feeds the next
+    round's train directly; the final value matches the materialized
+    (per-round fed.get) loop exactly."""
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import aggregate
+
+    parties = ("alice", "bob", "carol")
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    class Adder:
+        def __init__(self, inc):
+            self._inc = float(inc)
+
+        def bump(self, tree):
+            return {"w": tree["w"] + self._inc}
+
+    actors = {p: Adder.party(p).remote(i + 1) for i, p in enumerate(parties)}
+
+    def round_lazy(tree_or_obj):
+        return aggregate(
+            [actors[p].bump.remote(tree_or_obj) for p in parties],
+            mode="coordinator",
+            materialize=False,
+        )
+
+    # 3 pipelined rounds, one fed.get at the end.
+    obj = round_lazy({"w": jnp.zeros((4,))})
+    obj = round_lazy(obj)
+    obj = round_lazy(obj)
+    result = fed.get(obj)
+    # Each round adds mean(1,2,3) = 2.0.
+    np.testing.assert_allclose(np.asarray(result["w"]), 6.0, rtol=1e-6)
+
+    # materialize=False is coordinator-only.
+    try:
+        aggregate(
+            [actors[p].bump.remote({"w": jnp.zeros(1)}) for p in parties[:2]],
+            mode="all_to_all",
+            materialize=False,
+        )
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+    fed.shutdown()
+
+
+def test_fedavg_lazy_pipelined_rounds():
+    run_parties(run_fedavg_lazy, ["alice", "bob", "carol"], args=(LAZY_CLUSTER,))
 
 
 SPLIT_CLUSTER = make_cluster(["alice", "bob"])
