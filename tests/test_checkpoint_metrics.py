@@ -44,6 +44,29 @@ def test_checkpoint_restore_specific_round(tmp_path):
     np.testing.assert_allclose(restored["x"], [1.0, 1.0])
 
 
+@pytest.mark.parametrize("use_orbax", [True, False])
+def test_checkpoint_int8_roundtrip(tmp_path, use_orbax):
+    """A quantized base (QTensor leaves) restores bit-exactly — the 8B
+    LoRA resume path never materializes a full-precision tree."""
+    from rayfed_tpu.models.quant import QTensor, quantize_int8
+
+    tree = {
+        "w": quantize_int8(jax.random.normal(jax.random.PRNGKey(0), (8, 16))),
+        "b": jnp.ones((4,)),
+    }
+    ckpt = FedCheckpointer(str(tmp_path), "alice", use_orbax=use_orbax)
+    ckpt.save(1, tree)
+    _, restored = ckpt.restore(target=tree)
+    assert isinstance(restored["w"], QTensor)
+    assert restored["w"].q.dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"].q), np.asarray(tree["w"].q)
+    )
+    np.testing.assert_allclose(
+        np.asarray(restored["w"].scale), np.asarray(tree["w"].scale)
+    )
+
+
 def test_checkpoint_missing_raises(tmp_path):
     ckpt = FedCheckpointer(str(tmp_path), "carol", use_orbax=False)
     with pytest.raises(FileNotFoundError):
