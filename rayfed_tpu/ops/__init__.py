@@ -10,7 +10,11 @@ consumed by the model family in :mod:`rayfed_tpu.models`.
 
 from rayfed_tpu.ops.attention import dot_product_attention, mha
 from rayfed_tpu.ops.flash_attention import flash_attention
-from rayfed_tpu.ops.ring_attention import ring_attention, make_ring_attention
+from rayfed_tpu.ops.ring_attention import (
+    make_ring_attention,
+    ring_attention,
+    ring_flash_attention,
+)
 from rayfed_tpu.ops.ulysses import ulysses_attention, make_ulysses_attention
 
 __all__ = [
@@ -18,6 +22,7 @@ __all__ = [
     "mha",
     "flash_attention",
     "ring_attention",
+    "ring_flash_attention",
     "make_ring_attention",
     "ulysses_attention",
     "make_ulysses_attention",

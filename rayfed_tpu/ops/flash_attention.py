@@ -368,16 +368,34 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
+def _lse_delta_lanes(o, lse, do):
+    """Lane-broadcast (lse, delta) to (bh, t_q, 128) for the bwd kernels.
+
+    ``delta = rowsum(dO ∘ O)``; both depend only on (o, lse, do), so ring
+    callers hoist this out of their per-step loop.
+    """
+    bh, t_q, _ = o.shape
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # (bh, t_q)
+    lse_b = jnp.broadcast_to(lse[..., None], (bh, t_q, 128))
+    delta_b = jnp.broadcast_to(delta[..., None], (bh, t_q, 128))
+    return lse_b, delta_b
+
+
 def _flash_backward_pallas(
     q, k, v, o, lse, do, *, scale: float, causal: bool,
     block_q: int, block_k: int, q_offset: int, kv_offset: int, interpret: bool,
+    lse_delta_b=None,
 ):
     """Pallas flash backward on [BH, T, D] inputs → (dq, dk, dv).
 
     Two tiled kernels: dQ iterates kv blocks innermost (accumulator over
     the q row block), dK/dV iterates q blocks innermost (accumulators
     over the kv block).  ``delta = rowsum(dO ∘ O)`` and the saved lse are
-    lane-broadcast to 128 so their blocks satisfy TPU (8, 128) tiling.
+    lane-broadcast to 128 so their blocks satisfy TPU (8, 128) tiling;
+    pass ``lse_delta_b`` (from :func:`_lse_delta_lanes`) to reuse them
+    across calls that share (o, lse, do).
     """
     bh, t_q, d = q.shape
     t_k = k.shape[1]
@@ -388,11 +406,9 @@ def _flash_backward_pallas(
             f"block sizes ({block_q}, {block_k}) must divide the "
             f"sequence lengths ({t_q}, {t_k})"
         )
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    )  # (bh, t_q)
-    lse_b = jnp.broadcast_to(lse[..., None], (bh, t_q, 128))
-    delta_b = jnp.broadcast_to(delta[..., None], (bh, t_q, 128))
+    if lse_delta_b is None:
+        lse_delta_b = _lse_delta_lanes(o, lse, do)
+    lse_b, delta_b = lse_delta_b
 
     common = dict(
         scale=scale,

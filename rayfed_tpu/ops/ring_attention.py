@@ -13,12 +13,24 @@ Absent from the reference by design (SURVEY §5.7: "no ring attention,
 context parallel, blockwise, or Ulysses anywhere") — here it is a
 party-local sharding strategy of the compute layer.
 
-Two entry points:
+Two inner-step implementations:
 
-- :func:`ring_attention` — collective form, call *inside* ``shard_map``
-  with sequence-sharded [B, T_local, H, D] blocks.
-- :func:`make_ring_attention` — wraps it in ``shard_map`` over a mesh
-  axis; takes/returns global [B, T, H, D] arrays.
+- ``blockwise`` — the XLA online-softmax recurrence
+  (:func:`rayfed_tpu.ops.attention.blockwise_accumulate`); runs anywhere.
+- ``flash`` (:func:`ring_flash_attention`) — each ring step runs the
+  Pallas flash kernel on the resident K/V block and the per-step
+  (o, lse) partials merge by log-sum-exp; backward rings the K/V blocks
+  a second time, accumulating dK/dV *onto the rotating buffers* so each
+  block arrives home carrying its full gradient.  This is the TPU path:
+  the MXU sees the same tiled kernel as single-device flash attention.
+
+Entry points:
+
+- :func:`ring_attention` / :func:`ring_flash_attention` — collective
+  forms, call *inside* ``shard_map`` with sequence-sharded
+  [B, T_local, H, D] blocks.
+- :func:`make_ring_attention` — wraps either in ``shard_map`` over a
+  mesh axis; takes/returns global [B, T, H, D] arrays.
 """
 
 from __future__ import annotations
@@ -35,6 +47,16 @@ from rayfed_tpu.ops.attention import (
     blockwise_accumulate,
     blockwise_finalize,
     init_blockwise_state,
+)
+from rayfed_tpu.ops.flash_attention import (
+    NEG_INF,
+    _bht_to_bthd,
+    _bthd_to_bht,
+    _fit_block,
+    _flash_backward_pallas,
+    _flash_forward,
+    _lse_delta_lanes,
+    _on_tpu,
 )
 
 
@@ -86,23 +108,237 @@ def ring_attention(
     return blockwise_finalize(o, l, q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Flash-inner ring: pallas kernels per step, lse-merge across steps
+# ---------------------------------------------------------------------------
+
+
+def _merge_partial(o_acc, lse_acc, o_i, lse_i):
+    """Log-sum-exp merge of two *normalized* partial attention results.
+
+    ``o_acc`` f32 [BH, T, D] with normalizer ``lse_acc`` [BH, T]; fully
+    absent partials carry ``lse == NEG_INF`` and contribute nothing.
+    """
+    m = jnp.maximum(lse_acc, lse_i)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    w_acc = jnp.exp(jnp.where(lse_acc <= NEG_INF / 2, NEG_INF, lse_acc) - m_safe)
+    w_i = jnp.exp(jnp.where(lse_i <= NEG_INF / 2, NEG_INF, lse_i) - m_safe)
+    denom = w_acc + w_i
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (
+        o_acc * (w_acc / denom_safe)[..., None]
+        + o_i.astype(jnp.float32) * (w_i / denom_safe)[..., None]
+    )
+    lse = m + jnp.log(denom_safe)
+    return o, lse
+
+
+def _ring_flash_fwd_inner(
+    q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+):
+    """[BH, T, D] ring forward → (o f32, lse f32)."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    flash = functools.partial(
+        _flash_forward,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        q_offset=0,
+        kv_offset=0,
+        interpret=interpret,
+    )
+
+    # Step 0 is every device's own (diagonal) block — the only one that
+    # needs in-kernel causal masking, so it runs unrolled.  Later blocks
+    # are either entirely visible (owner before me in the ring) or
+    # entirely masked; visibility is applied to the partial's lse, so
+    # one causal=False kernel instance serves every scanned step.
+    o_0, lse_0 = flash(q, k, v, causal=causal)
+    o_acc = o_0.astype(jnp.float32)
+
+    def body(carry, step):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        o_i, lse_i = flash(q, k_cur, v_cur, causal=False)
+        if causal:
+            src = jnp.mod(my_idx - step, axis_size)
+            lse_i = jnp.where(src < my_idx, lse_i, NEG_INF)
+        o_acc, lse_acc = _merge_partial(o_acc, lse_acc, o_i, lse_i)
+        return (o_acc, lse_acc, k_cur, v_cur), None
+
+    (o_acc, lse_acc, _, _), _ = lax.scan(
+        body, (o_acc, lse_0, k, v), jnp.arange(1, axis_size)
+    )
+    return o_acc, lse_acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash_bht(
+    q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+):
+    out, _ = _ring_flash_fwd(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd(
+    q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+):
+    o_acc, lse = _ring_flash_fwd_inner(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    out = o_acc.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(
+    axis_name, causal, scale, block_q, block_k, interpret, res, do
+):
+    """Backward ring: K/V make a second full loop, dK/dV ride along.
+
+    Each step runs the standard flash backward kernels (dQ and dK/dV)
+    against the resident K/V block using the *final* lse/delta — the
+    global-softmax weights — and the dK/dV partials accumulate onto
+    buffers that rotate with their block; after ``axis_size`` rotations
+    every block (and its gradient) is back on its owner.
+    """
+    q, k, v, out, lse = res
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    # lse/delta lane-broadcasts depend only on (out, lse, do): hoist them
+    # out of the ring loop instead of recomputing per step.
+    lse_delta_b = _lse_delta_lanes(out, lse, do)
+    bwd = functools.partial(
+        _flash_backward_pallas,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        q_offset=0,
+        kv_offset=0,
+        interpret=interpret,
+        lse_delta_b=lse_delta_b,
+    )
+
+    # Step 0: the diagonal block, in-kernel causal mask (see fwd).
+    dq_0, dk_0, dv_0 = bwd(q, k, v, out, lse, do, causal=causal)
+
+    def body(carry, step):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        # Rotate gradients WITH their block so each block accumulates
+        # its contributions as it tours the ring.
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+        dq_i, dk_i, dv_i = bwd(q, k_cur, v_cur, out, lse, do, causal=False)
+        if causal:
+            # jnp.where, not a multiply: an invisible block's kernel
+            # output is exp(s - lse) of scores the softmax never saw —
+            # potentially inf, and inf·0 would poison the sum with NaN.
+            src = jnp.mod(my_idx - step, axis_size)
+            visible = src < my_idx
+            dq_i = jnp.where(visible, dq_i, 0)
+            dk_i = jnp.where(visible, dk_i, 0)
+            dv_i = jnp.where(visible, dv_i, 0)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dk_cur = dk_cur + dk_i.astype(jnp.float32)
+        dv_cur = dv_cur + dv_i.astype(jnp.float32)
+        return (dq_acc, k_cur, v_cur, dk_cur, dv_cur), None
+
+    carry0 = (
+        dq_0.astype(jnp.float32),
+        k,
+        v,
+        dk_0.astype(jnp.float32),
+        dv_0.astype(jnp.float32),
+    )
+    (dq_acc, _, _, dk_cur, dv_cur), _ = lax.scan(
+        body, carry0, jnp.arange(1, axis_size)
+    )
+    # One final hop delivers each block's accumulated gradient home.
+    dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+    dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+    return (
+        dq_acc.astype(q.dtype),
+        dk_cur.astype(k.dtype),
+        dv_cur.astype(v.dtype),
+    )
+
+
+_ring_flash_bht.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the inner step.
+
+    Same contract as :func:`ring_attention` (call inside ``shard_map``
+    with [B, T_local, H, D] sequence shards; shard *i* holds global
+    positions ``[i·T_local, (i+1)·T_local)``) — but each step's block
+    attention runs the tiled MXU kernel and the per-step results merge
+    by log-sum-exp, so per-block throughput matches single-device
+    :func:`rayfed_tpu.ops.flash_attention.flash_attention`.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    t_local = q.shape[1]
+    block_q = _fit_block(t_local, block_q)
+    block_k = _fit_block(k.shape[1], block_k)
+    qh, kh, vh = _bthd_to_bht(q), _bthd_to_bht(k), _bthd_to_bht(v)
+    oh = _ring_flash_bht(
+        qh, kh, vh, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return _bht_to_bthd(oh, q.shape[0], q.shape[2])
+
+
 def make_ring_attention(
     mesh: Mesh,
     seq_axis: str = "sp",
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    use_flash: bool = False,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ):
     """Build a global-view ring attention fn sharded over ``mesh[seq_axis]``.
 
     Returned fn maps [B, T, H, D] → [B, T, H, D] with T sharded over
     ``seq_axis`` (T must divide evenly).  Batch stays replicated here;
-    compose with dp by vmapping/sharding outside.
+    compose with dp by vmapping/sharding outside.  ``use_flash=True``
+    runs the Pallas flash kernel per ring step (the TPU-fast path;
+    interpreted off-TPU so the CPU test mesh exercises it too).
     """
     spec = P(None, seq_axis, None, None)
-    fn = functools.partial(
-        ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
-    )
+    if use_flash:
+        fn = functools.partial(
+            ring_flash_attention,
+            axis_name=seq_axis,
+            causal=causal,
+            sm_scale=sm_scale,
+            block_q=block_q,
+            block_k=block_k,
+        )
+    else:
+        fn = functools.partial(
+            ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+        )
     return jax.shard_map(
         fn,
         mesh=mesh,

@@ -72,6 +72,44 @@ def test_ring_gradients_match():
         np.testing.assert_allclose(gr, gd, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(causal):
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(5), t=64, d=16)
+    ring = jax.jit(make_ring_attention(mesh, "sp", causal=causal, use_flash=True))
+    expected = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(ring(q, k, v), expected, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_gradients_match():
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(6), t=32, d=16)
+    ring = make_ring_attention(mesh, "sp", causal=True, use_flash=True)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(gr, gd, atol=5e-4, rtol=5e-4)
+
+
+def test_ring_flash_bf16():
+    mesh = create_mesh({"sp": 8})
+    q, k, v = _qkv(jax.random.PRNGKey(7), t=64, d=16, dtype=jnp.bfloat16)
+    ring = jax.jit(make_ring_attention(mesh, "sp", causal=True, use_flash=True))
+    out = ring(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    expected = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        out.astype(np.float32), expected.astype(np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
 def test_ulysses_requires_divisible_heads():
     mesh = create_mesh({"sp": 8})
     q, k, v = _qkv(jax.random.PRNGKey(4), h=4)  # 4 heads, 8-way axis
