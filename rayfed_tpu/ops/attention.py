@@ -61,6 +61,43 @@ def dot_product_attention(
     return o.astype(orig_dtype)
 
 
+def as_attn_fn(sharded, built_causal: bool, built_scale, builder: str):
+    """Give a shard_map'd (q, k, v) attention the ``attn_fn`` signature.
+
+    Model code (:func:`mha`, ``apply_llama``) calls ``attn_fn(q, k, v,
+    causal=..., sm_scale=...)``; a ring/Ulysses builder bakes masking and
+    scale in at build time, so the wrapper accepts those kwargs and
+    rejects *conflicting* values instead of silently ignoring them.
+    """
+
+    def apply(q, k, v, *, causal=None, sm_scale=None, mask=None):
+        if mask is not None:
+            raise ValueError(
+                f"{builder} attention does not support a dense mask"
+            )
+        if causal is not None and bool(causal) != built_causal:
+            raise ValueError(
+                f"causal={causal} conflicts with the {builder}(...) "
+                f"build-time setting causal={built_causal}"
+            )
+        if sm_scale is not None:
+            # A builder given sm_scale=None applies the conventional
+            # d**-0.5 — an explicit caller value equal to that effective
+            # scale is agreement, not conflict.
+            effective = (
+                built_scale if built_scale is not None
+                else q.shape[-1] ** -0.5
+            )
+            if sm_scale != effective:
+                raise ValueError(
+                    f"sm_scale={sm_scale} conflicts with the {builder}(...) "
+                    f"build-time scale {effective}"
+                )
+        return sharded(q, k, v)
+
+    return apply
+
+
 def mha(
     x: jax.Array,
     wq: jax.Array,

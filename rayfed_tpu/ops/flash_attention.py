@@ -167,8 +167,13 @@ def _flash_forward(
     q_offset: int,
     kv_offset: int,
     interpret: bool,
+    out_dtype=None,
 ):
     """Run the pallas kernel on [BH, T, D] inputs; returns (o, lse).
+
+    ``out_dtype`` overrides the output dtype of ``o`` (default: q's) —
+    ring callers take f32 so per-step partials are not rounded to bf16
+    before the cross-step merge.
 
     The head dim is used directly as the block lane dim — Mosaic pads
     sub-128 tiles internally, which beats explicitly zero-padding to 128
@@ -218,7 +223,7 @@ def _flash_forward(
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, t_q, 128), jnp.float32),
         ],
         scratch_shapes=scratch,
@@ -386,9 +391,13 @@ def _lse_delta_lanes(o, lse, do):
 def _flash_backward_pallas(
     q, k, v, o, lse, do, *, scale: float, causal: bool,
     block_q: int, block_k: int, q_offset: int, kv_offset: int, interpret: bool,
-    lse_delta_b=None,
+    lse_delta_b=None, out_dtype=None,
 ):
     """Pallas flash backward on [BH, T, D] inputs → (dq, dk, dv).
+
+    ``out_dtype`` overrides the gradients' dtype (default: the inputs') —
+    ring callers take f32 so per-step partials are not rounded to bf16
+    before cross-step accumulation.
 
     Two tiled kernels: dQ iterates kv blocks innermost (accumulator over
     the q row block), dK/dV iterates q blocks innermost (accumulators
@@ -430,7 +439,7 @@ def _flash_backward_pallas(
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
@@ -451,8 +460,8 @@ def _flash_backward_pallas(
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t_k, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), out_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

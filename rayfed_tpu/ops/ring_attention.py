@@ -44,6 +44,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from rayfed_tpu.ops.attention import (
+    as_attn_fn,
     blockwise_accumulate,
     blockwise_finalize,
     init_blockwise_state,
@@ -148,6 +149,9 @@ def _ring_flash_fwd_inner(
         q_offset=0,
         kv_offset=0,
         interpret=interpret,
+        # f32 partials: rounding each step's o to bf16 before the merge
+        # would accumulate error with ring size; round once at the end.
+        out_dtype=jnp.float32,
     )
 
     # Step 0 is every device's own (diagonal) block — the only one that
@@ -155,8 +159,7 @@ def _ring_flash_fwd_inner(
     # are either entirely visible (owner before me in the ring) or
     # entirely masked; visibility is applied to the partial's lse, so
     # one causal=False kernel instance serves every scanned step.
-    o_0, lse_0 = flash(q, k, v, causal=causal)
-    o_acc = o_0.astype(jnp.float32)
+    o_acc, lse_0 = flash(q, k, v, causal=causal)
 
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
@@ -222,6 +225,8 @@ def _ring_flash_bwd(
         kv_offset=0,
         interpret=interpret,
         lse_delta_b=lse_delta_b,
+        # f32 partials — see the forward's out_dtype note.
+        out_dtype=jnp.float32,
     )
 
     # Step 0: the diagonal block, in-kernel causal mask (see fwd).
@@ -339,10 +344,11 @@ def make_ring_attention(
         fn = functools.partial(
             ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
         )
-    return jax.shard_map(
+    sharded = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
+    return as_attn_fn(sharded, causal, sm_scale, "make_ring_attention")

@@ -23,7 +23,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from rayfed_tpu.ops.attention import dot_product_attention
+from rayfed_tpu.ops.attention import as_attn_fn, dot_product_attention
 
 
 def ulysses_attention(
@@ -79,10 +79,11 @@ def make_ulysses_attention(
         sm_scale=sm_scale,
         attn_fn=attn_fn,
     )
-    return jax.shard_map(
+    sharded = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
+    return as_attn_fn(sharded, causal, sm_scale, "make_ulysses_attention")

@@ -279,6 +279,12 @@ class TransportClient:
             raise SendError(str(e)) from e
         finally:
             conn.pending.pop(rid, None)
+            # A write failure raises out of this coroutine after
+            # _teardown already set an exception on our own ACK future;
+            # mark it retrieved so GC doesn't log "Future exception was
+            # never retrieved" (the caller sees the write error instead).
+            if fut.done() and not fut.cancelled():
+                fut.exception()
 
     async def _write_frame(
         self, loop, conn: _Conn, frame_bufs: List, payload_bufs: List,

@@ -128,6 +128,28 @@ def test_llama_flash_attention_matches_dense():
     np.testing.assert_allclose(dense, flash, atol=1e-4, rtol=1e-4)
 
 
+def test_llama_ring_sp_matches_dense():
+    """Long-context path: the model forward under a 4-way sequence-
+    parallel mesh (flash-inner ring attention) equals the dense forward —
+    ring/Ulysses plug straight into ``attn_fn`` (kwarg-compatible)."""
+    from rayfed_tpu.ops import make_ring_attention
+
+    cfg = llama.llama_tiny()
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+    dense = llama.apply_llama(params, ids, cfg)
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    ring = make_ring_attention(mesh, "sp", causal=True, use_flash=True)
+    out = jax.jit(
+        lambda p, i: llama.apply_llama(p, i, cfg, attn_fn=ring)
+    )(params, ids)
+    np.testing.assert_allclose(dense, out, atol=2e-4, rtol=2e-4)
+    # Conflicting build-time/call-time settings are rejected, not ignored.
+    non_causal = make_ring_attention(mesh, "sp", causal=False)
+    with pytest.raises(ValueError, match="conflicts"):
+        llama.apply_llama(params, ids, cfg, attn_fn=non_causal)
+
+
 def test_llama_lora_train_decreases_loss():
     cfg = llama.llama_tiny()
     params = llama.init_llama(jax.random.PRNGKey(0), cfg)
