@@ -16,6 +16,7 @@ Accumulation is float32 regardless of input dtype (bf16-safe).
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -88,7 +89,9 @@ def as_attn_fn(sharded, built_causal: bool, built_scale, builder: str):
                 built_scale if built_scale is not None
                 else q.shape[-1] ** -0.5
             )
-            if sm_scale != effective:
+            # isclose, not ==: 1/math.sqrt(d) and d**-0.5 differ by an
+            # ulp for many head dims — that is agreement, not conflict.
+            if not math.isclose(sm_scale, effective, rel_tol=1e-9):
                 raise ValueError(
                     f"sm_scale={sm_scale} conflicts with the {builder}(...) "
                     f"build-time scale {effective}"

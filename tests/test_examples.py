@@ -7,6 +7,7 @@ from tests.multiproc import run_parties
 
 from examples.fedavg_mnist import run as run_fedavg_example
 from examples.lora_finetune import run as run_lora_example
+from examples.split_fl_bert import run as run_split_example
 
 
 def test_fedavg_mnist_example():
@@ -17,3 +18,7 @@ def test_fedavg_mnist_example():
 
 def test_lora_finetune_example():
     run_parties(run_lora_example, ["alice", "bob"], args=(1,), timeout=240)
+
+
+def test_split_fl_bert_example():
+    run_parties(run_split_example, ["alice", "bob"], args=(2,), timeout=240)
