@@ -81,6 +81,10 @@ def main():
         p.start()
     for p in procs:
         p.join(300)
+    for p in procs:
+        if p.is_alive():  # a hung party must fail the run, not wedge it
+            p.terminate()
+            p.join(10)
     codes = [p.exitcode for p in procs]
     assert codes == [0, 0], codes
     print("fedavg_mnist: both parties exited 0")

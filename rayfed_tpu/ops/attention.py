@@ -89,9 +89,10 @@ def as_attn_fn(sharded, built_causal: bool, built_scale, builder: str):
                 built_scale if built_scale is not None
                 else q.shape[-1] ** -0.5
             )
-            # isclose, not ==: 1/math.sqrt(d) and d**-0.5 differ by an
-            # ulp for many head dims — that is agreement, not conflict.
-            if not math.isclose(sm_scale, effective, rel_tol=1e-9):
+            # isclose, not ==: 1/math.sqrt(d), d**-0.5, and an f32-stored
+            # copy of either differ by ulps — agreement, not conflict.
+            # rel_tol covers float32 provenance (~1e-7 ulp).
+            if not math.isclose(sm_scale, effective, rel_tol=1e-6):
                 raise ValueError(
                     f"sm_scale={sm_scale} conflicts with the {builder}(...) "
                     f"build-time scale {effective}"

@@ -112,6 +112,27 @@ def test_init_llama_int8_shapes_and_forward():
     assert np.all(np.isfinite(np.asarray(logits, np.float32)))
 
 
+def test_int8_decode_matches_int8_forward():
+    """KV-cache decode over an int8 base reproduces the int8 training
+    forward token-by-token — the bench's int8 decode path is exact."""
+    cfg = llama.llama_tiny()
+    params = llama.quantize_llama_base(
+        llama.init_llama(jax.random.PRNGKey(0), cfg)
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+    ref = llama.apply_llama(params, ids, cfg)
+    cache = llama.init_kv_cache(cfg, 2, 8)
+    step = llama.make_decode_step(cfg)
+    outs = []
+    for t in range(8):
+        cache, logits = step(params, cache, ids[:, t], t)
+        outs.append(logits)
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(dec), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_merge_lora_rejects_quantized_base():
     cfg = llama.llama_tiny()
     base = llama.quantize_llama_base(llama.init_llama(jax.random.PRNGKey(0), cfg))
