@@ -734,6 +734,52 @@ def bench_llama() -> dict:
     }
 
 
+def _decode_slope(cfg, params, prompt, n_short, n_long, attn_fn, reps=3):
+    """Steady-state decode seconds/token by slope between two generation
+    lengths (same prompt/prefill work in both → the delta is pure
+    decode), median-of-``reps``.  Returns ``(per_tok, eff_len)``.
+
+    ``eff_len``: the decode step streams the FULL padded cache buffer
+    (t0 + n_new) every step — validity is a mask, not a dynamic extent —
+    so the slope's effective per-token cache traffic is the difference
+    of the two runs' total cache reads, not the mean live length.
+    """
+    import jax.numpy as jnp
+
+    from rayfed_tpu.models import llama
+
+    t0 = prompt.shape[1]
+
+    def timed(n_new):
+        g = jax.jit(
+            lambda p, pr: llama.greedy_generate(
+                p, cfg, pr, n_new, attn_fn=attn_fn
+            )
+        )
+        out = g(params, prompt)
+        jax.block_until_ready(out)
+        vals = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = g(params, prompt)
+            float(jax.device_get(jnp.sum(out)))
+            vals.append(time.perf_counter() - t)
+        return sorted(vals)[len(vals) // 2]
+
+    per_tok = max(
+        (timed(n_long) - timed(n_short)) / (n_long - n_short), 1e-9
+    )
+    eff_len = (
+        n_long * (t0 + n_long) - n_short * (t0 + n_short)
+    ) / (n_long - n_short)
+    return per_tok, eff_len
+
+
+def _kv_cache_bytes(cfg, batch, eff_len):
+    """HBM bytes of live bf16 KV cache streamed per decode step."""
+    return 2 * cfg.num_layers * batch * eff_len * cfg.num_kv_heads * cfg.head_dim * 2
+
+
 def bench_lora_8b() -> dict:
     """BASELINE.md #4 at literal scale: Llama-3-8B LoRA on one chip.
 
@@ -795,13 +841,43 @@ def bench_lora_8b() -> dict:
 
     abstract = jax.eval_shape(lambda: llama.init_llama(jax.random.PRNGKey(0), cfg))
     n_params = llama.param_count(abstract)
-    return {
+
+    out = {
         "lora_8b_tokens_per_sec": round(batch * seq / step_time, 1),
         "lora_8b_step_ms": round(step_time * 1e3, 2),
         "lora_8b_params_b": round(n_params / 1e9, 2),
         "lora_8b_base_gb": round(tree_nbytes(base) / 1e9, 2),
         "lora_8b_adapter_mb": round(adapter_mb, 2),
     }
+
+    # 8B int8 serving on the same chip: KV-cache greedy decode over the
+    # already-resident base (the decode step streams ~8.6 GB of weights
+    # + the live cache per token — the serving-side complement of the
+    # train number above).  A decode failure must not discard the train
+    # numbers already measured.
+    try:
+        _log("  compiling 8B int8 decode generations (short+long)...")
+        dbatch = 4
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(3), (dbatch, 128), 0, cfg.vocab_size
+        )
+        per_tok, eff_len = _decode_slope(
+            cfg, base, prompt, 16, 272, flash_attention
+        )
+        membw_util = (
+            (tree_nbytes(base) + _kv_cache_bytes(cfg, dbatch, eff_len))
+            / per_tok
+            / _peak_hbm_bps()
+        )
+        out.update(
+            decode_8b_tokens_per_sec=round(dbatch / per_tok, 1),
+            decode_8b_step_ms=round(per_tok * 1e3, 2),
+            decode_8b_membw_util=round(membw_util, 4),
+        )
+    except Exception as e:  # pragma: no cover - chip-memory dependent
+        _log(f"  8B decode skipped: {e!r}")
+        out["decode_8b_error"] = repr(e)[:200]
+    return out
 
 
 def bench_decode() -> dict:
@@ -832,27 +908,10 @@ def bench_decode() -> dict:
         jax.random.PRNGKey(1), (batch, t0), 0, cfg.vocab_size
     )
 
-    def timed(p, n_new, reps=3):
-        g = jax.jit(
-            lambda p, pr: llama.greedy_generate(
-                p, cfg, pr, n_new, attn_fn=flash_attention
-            )
-        )
-        out = g(p, prompt)
-        jax.block_until_ready(out)
-        vals = []
-        for _ in range(reps):
-            t = time.perf_counter()
-            out = g(p, prompt)
-            float(jax.device_get(jnp.sum(out)))
-            vals.append(time.perf_counter() - t)
-        return sorted(vals)[len(vals) // 2]
-
     _log("  compiling decode generations (short+long)...")
     n_short, n_long = 16, 528
-    per_tok = max(
-        (timed(params, n_long) - timed(params, n_short)) / (n_long - n_short),
-        1e-9,
+    per_tok, eff_len = _decode_slope(
+        cfg, params, prompt, n_short, n_long, flash_attention
     )
 
     # int8 weight-only decode: the step is memory-bound, so halving the
@@ -862,39 +921,21 @@ def bench_decode() -> dict:
     from rayfed_tpu.models.quant import tree_nbytes
 
     qparams = llama.quantize_llama_base(params)
-    per_tok_q = max(
-        (timed(qparams, n_long) - timed(qparams, n_short))
-        / (n_long - n_short),
-        1e-9,
+    per_tok_q, _ = _decode_slope(
+        cfg, qparams, prompt, n_short, n_long, flash_attention
     )
     qparam_bytes = tree_nbytes(qparams)
 
     # Memory-bandwidth roofline (mirrors how llama_mfu anchors the train
     # bench): each decode step streams every parameter (bf16) plus the
-    # live KV cache region once from HBM.  Cache bytes use the mean
-    # sequence length over the measured window.
+    # live KV cache region once from HBM; cache-extent model documented
+    # on _decode_slope.
     abstract = jax.eval_shape(lambda: llama.init_llama(jax.random.PRNGKey(0), cfg))
     param_bytes = sum(
         leaf.size * leaf.dtype.itemsize
         for leaf in jax.tree_util.tree_leaves(abstract)
     )
-    # The decode step streams the FULL padded cache buffer (t0 + n_new)
-    # every step — validity is a mask, not a dynamic extent — so the
-    # slope's effective per-token cache traffic is the difference of the
-    # two runs' total cache reads, not the mean live length.
-    eff_len = (
-        n_long * (t0 + n_long) - n_short * (t0 + n_short)
-    ) / (n_long - n_short)
-    head_dim = cfg.hidden_size // cfg.num_heads
-    kv_bytes = (
-        2  # k + v
-        * cfg.num_layers
-        * batch
-        * eff_len
-        * cfg.num_kv_heads
-        * head_dim
-        * 2  # bf16
-    )
+    kv_bytes = _kv_cache_bytes(cfg, batch, eff_len)
     membw_util = (param_bytes + kv_bytes) / per_tok / _peak_hbm_bps()
     membw_util_q = (qparam_bytes + kv_bytes) / per_tok_q / _peak_hbm_bps()
     return {
