@@ -664,7 +664,7 @@ def bench_llama() -> dict:
 
     bf16 params + first moment (second moment f32, arithmetic f32 inside
     the update) and scan-layer remat are what fit 1B params of
-    model+optimizer state on one 16 GB v5e chip at seq 2048.
+    model+optimizer state on one 16 GB v5e chip.
     """
     import jax.numpy as jnp
 
@@ -683,8 +683,10 @@ def bench_llama() -> dict:
         param_dtype=jnp.bfloat16,
         remat=True,
         # Selective remat: keep non-batch matmul outputs resident.
-        # On-chip sweep: b=2 + "dots" = MFU 0.566 vs b=4 full-remat
-        # 0.540 (b=4 + "dots" exceeds HBM).
+        # On-chip shape/policy sweep (4096 tokens/step each, scored by
+        # THIS bench's attention-aware MFU): b=2 s=2048 "dots" = 0.572
+        # vs b=1 s=4096 "dots" 0.547, b=4 s=2048 full-remat 0.540;
+        # b=2 s=2048 no-remat and b=1 s=8192 exceed HBM.
         remat_policy="dots",
     )
     batch, seq = 2, 2048
