@@ -777,9 +777,17 @@ def _decode_slope(cfg, params, prompt, n_short, n_long, attn_fn, reps=3):
     return per_tok, eff_len
 
 
-def _kv_cache_bytes(cfg, batch, eff_len):
-    """HBM bytes of live bf16 KV cache streamed per decode step."""
-    return 2 * cfg.num_layers * batch * eff_len * cfg.num_kv_heads * cfg.head_dim * 2
+def _kv_cache_bytes(cfg, batch, eff_len, quantized=False):
+    """HBM bytes of live KV cache streamed per decode step.
+
+    bf16: 2 bytes/element; int8 (``kv_quant``): 1 byte plus the f32
+    per-(position, head) scale amortized over the head dim.
+    """
+    per_elem = (1 + 4 / cfg.head_dim) if quantized else 2
+    return int(
+        2 * cfg.num_layers * batch * eff_len
+        * cfg.num_kv_heads * cfg.head_dim * per_elem
+    )
 
 
 def bench_lora_8b() -> dict:
@@ -940,7 +948,7 @@ def bench_decode() -> dict:
     kv_bytes = _kv_cache_bytes(cfg, batch, eff_len)
     membw_util = (param_bytes + kv_bytes) / per_tok / _peak_hbm_bps()
     membw_util_q = (qparam_bytes + kv_bytes) / per_tok_q / _peak_hbm_bps()
-    return {
+    out = {
         "decode_tokens_per_sec": round(batch / per_tok, 1),
         "decode_step_ms": round(per_tok * 1e3, 2),
         "decode_membw_util": round(membw_util, 4),
@@ -949,6 +957,50 @@ def bench_decode() -> dict:
         "decode_int8_membw_util": round(membw_util_q, 4),
         "decode_int8_speedup": round(per_tok / per_tok_q, 3),
     }
+
+    # Long-context serving: at t0=1536 the bf16 cache reads rival the
+    # weight reads, so int8 weights + int8 KV cache (kv_quant) nearly
+    # halve the whole step's HBM traffic — the case the quantized cache
+    # exists for.
+    _log("  compiling long-context decode (bf16 vs int8 w+kv)...")
+    import dataclasses as _dc
+
+    t0_long = 1536
+    prompt_long = jax.random.randint(
+        jax.random.PRNGKey(2), (batch, t0_long), 0, cfg.vocab_size
+    )
+    per_tok_l, eff_len_l = _decode_slope(
+        cfg, params, prompt_long, 16, 272, flash_attention
+    )
+    # int8 weights with the bf16 cache isolates the weight effect from
+    # the cache effect at this context length.
+    per_tok_lw, _ = _decode_slope(
+        cfg, qparams, prompt_long, 16, 272, flash_attention
+    )
+    cfg_q = _dc.replace(cfg, kv_quant=True)
+    per_tok_lq, _ = _decode_slope(
+        cfg_q, qparams, prompt_long, 16, 272, flash_attention
+    )
+    util_l = (
+        (param_bytes + _kv_cache_bytes(cfg, batch, eff_len_l))
+        / per_tok_l / _peak_hbm_bps()
+    )
+    util_lq = (
+        (qparam_bytes + _kv_cache_bytes(cfg_q, batch, eff_len_l, quantized=True))
+        / per_tok_lq / _peak_hbm_bps()
+    )
+    out.update(
+        decode_long_tokens_per_sec=round(batch / per_tok_l, 1),
+        decode_long_membw_util=round(util_l, 4),
+        decode_long_int8w_tokens_per_sec=round(batch / per_tok_lw, 1),
+        decode_long_int8_tokens_per_sec=round(batch / per_tok_lq, 1),
+        decode_long_int8_membw_util=round(util_lq, 4),
+        # Full int8 (weights + cache) over bf16, and the cache's own
+        # contribution on top of int8 weights.
+        decode_long_int8_speedup=round(per_tok_l / per_tok_lq, 3),
+        decode_long_kv_quant_speedup=round(per_tok_lw / per_tok_lq, 3),
+    )
+    return out
 
 
 def bench_flash() -> dict:
