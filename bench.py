@@ -777,13 +777,14 @@ def _decode_slope(cfg, params, prompt, n_short, n_long, attn_fn, reps=3):
     return per_tok, eff_len
 
 
-def _kv_cache_bytes(cfg, batch, eff_len, quantized=False):
+def _kv_cache_bytes(cfg, batch, eff_len):
     """HBM bytes of live KV cache streamed per decode step.
 
-    bf16: 2 bytes/element; int8 (``kv_quant``): 1 byte plus the f32
-    per-(position, head) scale amortized over the head dim.
+    Derived from ``cfg.kv_quant``: bf16 is 2 bytes/element; int8 is
+    1 byte plus the f32 per-(position, head) scale amortized over the
+    head dim.
     """
-    per_elem = (1 + 4 / cfg.head_dim) if quantized else 2
+    per_elem = (1 + 4 / cfg.head_dim) if cfg.kv_quant else 2
     return int(
         2 * cfg.num_layers * batch * eff_len
         * cfg.num_kv_heads * cfg.head_dim * per_elem
@@ -986,7 +987,7 @@ def bench_decode() -> dict:
         / per_tok_l / _peak_hbm_bps()
     )
     util_lq = (
-        (qparam_bytes + _kv_cache_bytes(cfg_q, batch, eff_len_l, quantized=True))
+        (qparam_bytes + _kv_cache_bytes(cfg_q, batch, eff_len_l))
         / per_tok_lq / _peak_hbm_bps()
     )
     out.update(

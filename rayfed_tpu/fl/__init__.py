@@ -9,10 +9,23 @@ Here the common algorithms ship with the framework:
   parties, plus an actor template for local training.
 - :mod:`split` — vertical/split FL: forward activations pushed one way,
   gradients pushed back (BASELINE.md config #5).
+- :mod:`fedopt` — server optimizers (FedAvgM/FedAdam/FedYogi) over the
+  round's pseudo-gradient, and the FedProx client loss wrapper.
+- :mod:`secure` — pairwise-masked secure aggregation (sum-only reveal).
+- :mod:`dp` — differential privacy: global-norm clipping + Gaussian
+  noise on outgoing updates.
 """
 
 from rayfed_tpu.fl.compression import compress, decompress
+from rayfed_tpu.fl.dp import clip_by_global_norm, privatize
 from rayfed_tpu.fl.fedavg import aggregate, tree_average, tree_weighted_sum
+from rayfed_tpu.fl.fedopt import (
+    fedprox_loss,
+    server_adam,
+    server_sgd,
+    server_yogi,
+)
+from rayfed_tpu.fl.secure import mask_update, unmask_sum
 from rayfed_tpu.fl.split import SplitTrainer
 
 __all__ = [
@@ -22,4 +35,12 @@ __all__ = [
     "SplitTrainer",
     "compress",
     "decompress",
+    "server_sgd",
+    "server_adam",
+    "server_yogi",
+    "fedprox_loss",
+    "mask_update",
+    "unmask_sum",
+    "privatize",
+    "clip_by_global_norm",
 ]
