@@ -27,6 +27,7 @@ from rayfed_tpu.fl.fedopt import (
 )
 from rayfed_tpu.fl.secure import mask_update, unmask_sum
 from rayfed_tpu.fl.split import SplitTrainer
+from rayfed_tpu.fl.trainer import run_fedavg_rounds
 
 __all__ = [
     "aggregate",
@@ -43,4 +44,5 @@ __all__ = [
     "unmask_sum",
     "privatize",
     "clip_by_global_norm",
+    "run_fedavg_rounds",
 ]

@@ -49,8 +49,13 @@ def pairwise_key(group_key: bytes, a: str, b: str, round_num: int) -> bytes:
     single masked update.
     """
     lo, hi = sorted((a, b))
+    lo_b, hi_b = lo.encode(), hi.encode()
+    # Length-prefixed components: a '|'-delimited preimage would let
+    # names containing '|' collide across pairs (('a','b|c') vs
+    # ('a|b','c')), handing one pair another pair's mask seed.
     return hashlib.sha256(
-        b"rayfed-secagg|%s|%s|%d|" % (lo.encode(), hi.encode(), round_num)
+        b"rayfed-secagg|%d:%s|%d:%s|%d|"
+        % (len(lo_b), lo_b, len(hi_b), hi_b, round_num)
         + group_key
     ).digest()
 

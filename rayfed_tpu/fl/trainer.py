@@ -1,0 +1,137 @@
+"""High-level federated training driver: the round loop as one call.
+
+The reference leaves the round loop to user code (its canonical shape
+is the hand-rolled loop in its ``tests/test_fed_get.py:47-82``); here
+the loop is a first-class driver that composes the framework's pieces —
+coordinator aggregation with pipelined (lazy) rounds, FedOpt server
+optimizers, bf16 wire compression, and per-party checkpoint/resume —
+while preserving the multi-controller contract: every party calls
+:func:`run_fedavg_rounds` at the same program point with the same
+arguments and walks the identical seq-id sequence.
+
+Checkpoint/resume: with a ``checkpointer``, each party snapshots
+``(round, params, server-opt state)`` every ``checkpoint_every`` rounds
+and the NEXT call resumes from the latest complete snapshot — restart
+all parties and the loop continues where it left off (deterministic
+seq-ids re-align the rendezvous, SURVEY §5.4's resume story).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from rayfed_tpu.fl.compression import compress, decompress
+from rayfed_tpu.fl.fedavg import aggregate
+from rayfed_tpu.fl.fedopt import ServerOptimizer
+
+
+def run_fedavg_rounds(
+    trainers: dict,
+    params: Any,
+    rounds: int,
+    *,
+    server_opt: Optional[ServerOptimizer] = None,
+    weights: Optional[Sequence[float]] = None,
+    compress_wire: bool = False,
+    checkpointer: Any = None,
+    checkpoint_every: int = 0,
+    on_round: Optional[Callable[[int, Any], None]] = None,
+) -> Any:
+    """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
+
+    ``trainers``: ``{party: actor}`` where ``actor.train(params)``
+    returns the party's updated tree (each party's actor runs only on
+    its own silo).  Every controller passes the identical arguments.
+
+    - ``server_opt``: apply a :mod:`rayfed_tpu.fl.fedopt` optimizer to
+      the round aggregate (plain replacement when ``None``).
+    - ``compress_wire``: halves the push bytes.  Trainer contract:
+      ``train`` must call :func:`~rayfed_tpu.fl.decompress` on its
+      argument (a no-op on full-precision input) and return
+      ``compress(updated)`` — in pipelined rounds the averaged bf16
+      tree flows straight back into ``train``; the driver decompresses
+      only what it returns or feeds the server optimizer.
+    - ``checkpointer``: a :class:`rayfed_tpu.checkpoint.FedCheckpointer`;
+      resume happens automatically from its latest complete round.
+    - ``on_round(i, params)``: called after each materialized round.
+
+    Without a server optimizer the rounds **pipeline**: the averaged
+    model flows into the next round as a lazy ``FedObject`` (no
+    ``fed.get`` barrier) and only the final round materializes.  A
+    server optimizer (or ``on_round``/checkpointing) materializes every
+    round — the server step is driver-side tree arithmetic.
+
+    Returns the final global params (identical on every controller).
+    """
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    if checkpoint_every and checkpointer is None:
+        raise ValueError("checkpoint_every set without a checkpointer")
+
+    from rayfed_tpu.fed_object import FedObject
+
+    state = server_opt.init(params) if server_opt is not None else None
+    start_round = 0
+
+    if checkpointer is not None and checkpointer.latest_round() is not None:
+        target = {"params": params}
+        if state is not None:
+            target["server_state"] = state
+        restored_round, snap = checkpointer.restore(target=target)
+        params = snap["params"]
+        if state is not None:
+            state = snap["server_state"]
+        start_round = restored_round
+        if start_round >= rounds:
+            return params
+
+    # Pipelined mode only when nothing needs the materialized value
+    # each round.
+    pipeline = (
+        server_opt is None
+        and on_round is None
+        and not checkpoint_every
+        and len(trainers) > 1
+    )
+
+    parties = list(trainers)
+    current: Any = params  # tree, or FedObject in pipelined rounds
+
+    for r in range(start_round, rounds):
+        # Wire form: a driver-held tree is compressed before the push;
+        # a lazy FedObject from a pipelined round is already the
+        # trainers' own (compressed) wire form.
+        outgoing = (
+            compress(current)
+            if compress_wire and not isinstance(current, FedObject)
+            else current
+        )
+        updates = [trainers[p].train.remote(outgoing) for p in parties]
+        if pipeline:
+            last = r == rounds - 1
+            current = aggregate(
+                updates,
+                weights,
+                mode="coordinator",
+                materialize=last,
+            )
+            if last and compress_wire:
+                current = decompress(current)
+            continue
+
+        avg = aggregate(updates, weights)
+        if compress_wire:
+            avg = decompress(avg)
+        if server_opt is not None:
+            current, state = server_opt.apply(current, avg, state)
+        else:
+            current = avg
+        if on_round is not None:
+            on_round(r, current)
+        if checkpoint_every and (r + 1) % checkpoint_every == 0:
+            snap = {"params": current}
+            if state is not None:
+                snap["server_state"] = state
+            checkpointer.save(r + 1, snap)
+
+    return current
