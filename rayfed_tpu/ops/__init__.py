@@ -14,6 +14,7 @@ from rayfed_tpu.ops.ring_attention import (
     make_ring_attention,
     ring_attention,
     ring_flash_attention,
+    zigzag_ring_flash_attention,
 )
 from rayfed_tpu.ops.ulysses import ulysses_attention, make_ulysses_attention
 
@@ -23,6 +24,7 @@ __all__ = [
     "flash_attention",
     "ring_attention",
     "ring_flash_attention",
+    "zigzag_ring_flash_attention",
     "make_ring_attention",
     "ulysses_attention",
     "make_ulysses_attention",

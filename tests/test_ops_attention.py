@@ -110,6 +110,53 @@ def test_ring_flash_bf16():
     )
 
 
+def test_zigzag_ring_matches_dense():
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(8), t=64, d=16)
+    zig = jax.jit(
+        make_ring_attention(
+            mesh, "sp", causal=True, use_flash=True, layout="zigzag"
+        )
+    )
+    expected = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(zig(q, k, v), expected, atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_ring_gradients_match():
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(9), t=32, d=16)
+    zig = make_ring_attention(
+        mesh, "sp", causal=True, use_flash=True, layout="zigzag"
+    )
+
+    def loss_zig(q, k, v):
+        return jnp.sum(zig(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g_zig = jax.jit(jax.grad(loss_zig, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gz, gd in zip(g_zig, g_dense):
+        np.testing.assert_allclose(gz, gd, atol=5e-4, rtol=5e-4)
+
+
+def test_zigzag_requires_causal_flash():
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="zigzag"):
+        make_ring_attention(mesh, "sp", causal=False, use_flash=True,
+                            layout="zigzag")
+    with pytest.raises(ValueError, match="zigzag"):
+        make_ring_attention(mesh, "sp", causal=True, use_flash=False,
+                            layout="zigzag")
+    zig = make_ring_attention(
+        mesh, "sp", causal=True, use_flash=True, layout="zigzag"
+    )
+    q, k, v = _qkv(jax.random.PRNGKey(10), t=36, d=16)  # 36 % 8 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        zig(q, k, v)
+
+
 def test_ulysses_requires_divisible_heads():
     mesh = create_mesh({"sp": 8})
     q, k, v = _qkv(jax.random.PRNGKey(4), h=4)  # 4 heads, 8-way axis
