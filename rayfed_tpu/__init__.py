@@ -30,7 +30,7 @@ from rayfed_tpu.metrics import get_stats
 from rayfed_tpu.proxy import send, recv
 from rayfed_tpu import tree_util
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "init",

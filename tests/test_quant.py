@@ -133,6 +133,46 @@ def test_int8_decode_matches_int8_forward():
     )
 
 
+def _run_qtensor_wire(party, cluster):
+    import jax
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.models.quant import QTensor, quantize_int8
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    def make():
+        return {
+            "w": quantize_int8(jax.random.normal(jax.random.PRNGKey(0), (64, 64))),
+            "b": jnp.ones((4,)),
+        }
+
+    val = fed.get(make.party("alice").remote())
+    assert isinstance(val["w"], QTensor), type(val["w"])
+    assert val["w"].q.dtype == jnp.int8
+    np.testing.assert_allclose(
+        np.asarray(val["w"].dequantize()),
+        np.asarray(
+            quantize_int8(
+                jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+            ).dequantize()
+        ),
+        atol=1e-6,
+    )
+    fed.shutdown()
+
+
+def test_qtensor_crosses_parties():
+    """A quantized tree pushes cross-party: q/scale array leaves ride
+    the zero-copy tensor wire (QTensor is a registered pytree node) and
+    the receiver reconstructs the QTensor — the federated-8B shape."""
+    from tests.multiproc import make_cluster, run_parties
+
+    cluster = make_cluster(["alice", "bob"])
+    run_parties(_run_qtensor_wire, ["alice", "bob"], args=(cluster,))
+
+
 def test_merge_lora_rejects_quantized_base():
     cfg = llama.llama_tiny()
     base = llama.quantize_llama_base(llama.init_llama(jax.random.PRNGKey(0), cfg))

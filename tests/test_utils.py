@@ -31,3 +31,16 @@ def test_validate_cluster_info():
         validate_cluster_info({"alice": {"address": "127.0.0.1:notaport"}})
     with pytest.raises(ValueError):
         validate_cluster_info({"alice": {"address": "127.0.0.1:99999999"}})
+
+
+def test_version_consistent_with_pyproject():
+    """__version__ and pyproject.toml must not drift (they did once)."""
+    import os
+    import re
+
+    import rayfed_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml")) as f:
+        m = re.search(r'^version = "([^"]+)"', f.read(), re.M)
+    assert m and m.group(1) == rayfed_tpu.__version__
