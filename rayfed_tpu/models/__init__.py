@@ -15,8 +15,14 @@ Families cover the BASELINE.md configs:
 - :mod:`bert`      — BERT-style encoder, split-FL friendly (config #5)
 - :mod:`llama`     — Llama-3-style decoder (RoPE/GQA/SwiGLU) (config #4)
 - :mod:`lora`      — LoRA adapters over any linear param (config #4)
+- :mod:`moe`       — mixture-of-experts layer, expert-parallel over ep
+- :mod:`quant`     — int8 weight-only quantization (frozen bases, KV)
+- :mod:`hf`        — Hugging Face Llama checkpoint conversion
+  (logit-parity verified against ``transformers``)
 """
 
-from rayfed_tpu.models import bert, llama, logistic, lora, moe, resnet
+from rayfed_tpu.models import bert, hf, llama, logistic, lora, moe, quant, resnet
 
-__all__ = ["logistic", "resnet", "bert", "llama", "lora", "moe"]
+__all__ = [
+    "logistic", "resnet", "bert", "llama", "lora", "moe", "quant", "hf",
+]

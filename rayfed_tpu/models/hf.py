@@ -1,0 +1,189 @@
+"""Hugging Face Llama checkpoint interop.
+
+``from_hf_llama`` converts a ``transformers`` Llama model (or its
+state dict) into this framework's param tree + :class:`LlamaConfig`,
+so real pretrained weights drop into every path here — training, LoRA,
+int8 quantization, KV-cache decode, and the federated exchanges.
+
+Two convention differences are handled explicitly:
+
+- **Weight orientation**: torch ``nn.Linear`` stores ``[out, in]``;
+  this framework right-multiplies ``x @ W`` with ``[in, out]`` — every
+  projection transposes.
+- **RoPE layout**: HF rotates half-split pairs ``(j, j+Dh/2)``
+  (``rotate_half``); this framework rotates interleaved pairs
+  ``(2j, 2j+1)``.  The two are equivalent up to a static permutation of
+  each head's output channels, applied here to ``wq``/``wk`` — after it
+  the *logits are identical*, verified against ``transformers`` in
+  ``tests/test_hf_interop.py``.
+
+Logit parity is exact (f32 tolerance); nothing of the runtime imports
+torch — the conversion is a one-time boundary step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from rayfed_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+
+def _np(x) -> np.ndarray:
+    """torch tensor / array-like → float32 numpy (host)."""
+    if hasattr(x, "detach"):  # torch tensor, no torch import needed
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _rope_perm(head_dim: int) -> np.ndarray:
+    """Channel permutation taking HF's half-split RoPE layout to this
+    framework's interleaved layout: out[2j] = j, out[2j+1] = j + Dh/2."""
+    half = head_dim // 2
+    perm = np.empty(head_dim, dtype=np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    return perm
+
+
+def _permute_heads(w: np.ndarray, num_heads: int, head_dim: int) -> np.ndarray:
+    """Apply the RoPE channel permutation per head on the out axis of a
+    transposed projection ``[in, H·Dh]``."""
+    d_in = w.shape[0]
+    w = w.reshape(d_in, num_heads, head_dim)
+    return w[:, :, _rope_perm(head_dim)].reshape(d_in, num_heads * head_dim)
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`.
+
+    Features this framework does not implement are rejected loudly —
+    silently dropping them would pass the shape audit and then diverge
+    from ``transformers`` at every position.
+    """
+    if getattr(hf_config, "rope_scaling", None):
+        raise NotImplementedError(
+            "rope_scaling (Llama-3.1+ long-context scaling) is not "
+            "implemented by rayfed_tpu.models.llama.rope_tables — "
+            "convert a checkpoint without it or extend rope_tables first"
+        )
+    if getattr(hf_config, "attention_bias", False) or getattr(
+        hf_config, "mlp_bias", False
+    ):
+        raise NotImplementedError(
+            "attention_bias/mlp_bias checkpoints are not supported "
+            "(this framework's Llama projections are bias-free)"
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        intermediate_size=hf_config.intermediate_size,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rms_eps=float(hf_config.rms_norm_eps),
+        max_seq_len=int(hf_config.max_position_embeddings),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+
+
+def from_hf_llama(
+    model_or_state: Any, config: Optional[LlamaConfig] = None
+) -> Tuple[Params, LlamaConfig]:
+    """Convert an HF Llama (model or state dict) → ``(params, config)``.
+
+    ``model_or_state``: a ``transformers`` ``LlamaForCausalLM`` (config
+    derived automatically) or its ``state_dict()`` (pass ``config``).
+    Returned params are float32 numpy-backed jnp arrays in this
+    framework's stacked-[L, ...] layout; cast or
+    :func:`~rayfed_tpu.models.llama.quantize_llama_base` afterwards as
+    needed.
+    """
+    if hasattr(model_or_state, "state_dict"):
+        state = model_or_state.state_dict()
+        if config is None:
+            config = config_from_hf(model_or_state.config)
+    else:
+        state = dict(model_or_state)
+        if config is None:
+            raise ValueError("pass config= when converting a raw state dict")
+
+    d, dh = config.hidden_size, config.head_dim
+    h, kvh, L = config.num_heads, config.num_kv_heads, config.num_layers
+
+    def get(name: str) -> np.ndarray:
+        if name not in state:
+            raise KeyError(
+                f"HF checkpoint is missing {name!r} — not a Llama-family "
+                f"state dict?"
+            )
+        return _np(state[name])
+
+    def proj(name: str) -> np.ndarray:
+        return get(name).T  # [out, in] -> [in, out]
+
+    layers: Dict[str, list] = {
+        k: []
+        for k in (
+            "attn_norm", "wq", "wk", "wv", "wo",
+            "mlp_norm", "w_gate", "w_up", "w_down",
+        )
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        layers["attn_norm"].append(get(p + "input_layernorm.weight"))
+        layers["wq"].append(
+            _permute_heads(proj(p + "self_attn.q_proj.weight"), h, dh)
+        )
+        layers["wk"].append(
+            _permute_heads(proj(p + "self_attn.k_proj.weight"), kvh, dh)
+        )
+        layers["wv"].append(proj(p + "self_attn.v_proj.weight"))
+        layers["wo"].append(proj(p + "self_attn.o_proj.weight"))
+        layers["mlp_norm"].append(get(p + "post_attention_layernorm.weight"))
+        layers["w_gate"].append(proj(p + "mlp.gate_proj.weight"))
+        layers["w_up"].append(proj(p + "mlp.up_proj.weight"))
+        layers["w_down"].append(proj(p + "mlp.down_proj.weight"))
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight")),
+        "layers": {
+            k: jnp.asarray(np.stack(v)) for k, v in layers.items()
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight")),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = jnp.asarray(proj("lm_head.weight"))
+
+    # Shape audit before handing the tree to jit: a silent mismatch
+    # (e.g. wrong num_kv_heads) would otherwise surface as an obscure
+    # einsum error deep inside the forward.
+    expect = {
+        "embed": (config.vocab_size, d),
+        "final_norm": (d,),
+    }
+    for name, shape in expect.items():
+        if params[name].shape != shape:
+            raise ValueError(
+                f"{name}: got {params[name].shape}, expected {shape}"
+            )
+    if params["layers"]["wq"].shape != (L, d, h * dh):
+        raise ValueError(
+            f"wq: got {params['layers']['wq'].shape}, expected "
+            f"{(L, d, h * dh)}"
+        )
+    if params["layers"]["wk"].shape != (L, d, kvh * dh):
+        raise ValueError(
+            f"wk: got {params['layers']['wk'].shape}, expected "
+            f"{(L, d, kvh * dh)}"
+        )
+    return params, config
