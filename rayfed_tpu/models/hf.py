@@ -78,6 +78,13 @@ def config_from_hf(hf_config) -> LlamaConfig:
             "attention_bias/mlp_bias checkpoints are not supported "
             "(this framework's Llama projections are bias-free)"
         )
+    implied = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit = getattr(hf_config, "head_dim", None)
+    if explicit is not None and explicit != implied:
+        raise NotImplementedError(
+            f"explicit head_dim={explicit} != hidden_size//num_heads="
+            f"{implied}: this framework derives head_dim from the config"
+        )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,

@@ -42,7 +42,8 @@ def _on_tpu() -> bool:
 
 
 def _causal_dispatch(
-    compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+    compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset,
+    window=None,
 ):
     """Run ``compute(masked)`` under the causal block classification.
 
@@ -50,6 +51,11 @@ def _causal_dispatch(
     block entirely at-or-before it needs no mask; only blocks straddling
     the diagonal pay for the iota/compare/select.  Shared by all three
     kernels so the boundary conditions cannot drift.
+
+    ``window`` (sliding-window attention, requires ``causal``): query q
+    sees keys in ``(q − window, q]``.  Blocks entirely below the band
+    are skipped the same way fully-future blocks are — the kernel's
+    FLOPs scale with O(T·window) instead of O(T²/2).
     """
     if not causal:
         compute(False)
@@ -60,6 +66,11 @@ def _causal_dispatch(
     kv_last = kv_first + block_k - 1
     active = kv_first <= q_last
     straddles = kv_last > q_first
+    if window is not None:
+        # Band-active: some pair satisfies q − k < window.
+        active = active & (kv_last > q_first - window)
+        # Band-straddling: the OLDEST pair falls outside the window.
+        straddles = straddles | (q_last - kv_first >= window)
 
     @pl.when(active & jnp.logical_not(straddles))
     def _full():
@@ -86,6 +97,7 @@ def _flash_fwd_kernel(
     block_k: int,
     q_offset: int,
     kv_offset: int,
+    window=None,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -119,7 +131,10 @@ def _flash_fwd_kernel(
             k_pos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            visible = q_pos >= k_pos
+            if window is not None:
+                visible = visible & (q_pos - k_pos < window)
+            s = jnp.where(visible, s, NEG_INF)
 
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -142,7 +157,8 @@ def _flash_fwd_kernel(
         l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
 
     _causal_dispatch(
-        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset,
+        window=window,
     )
 
     @pl.when(ki == num_k - 1)
@@ -168,6 +184,7 @@ def _flash_forward(
     kv_offset: int,
     interpret: bool,
     out_dtype=None,
+    window=None,
 ):
     """Run the pallas kernel on [BH, T, D] inputs; returns (o, lse).
 
@@ -204,6 +221,7 @@ def _flash_forward(
         block_k=block_k,
         q_offset=q_offset,
         kv_offset=kv_offset,
+        window=window,
     )
     scratch = [
         pltpu.VMEM((block_q, d), jnp.float32),
@@ -248,6 +266,7 @@ def _flash_bwd_dq_kernel(
     block_k: int,
     q_offset: int,
     kv_offset: int,
+    window=None,
 ):
     """dQ = (P ∘ (dO Vᵀ − D)) K · scale, accumulated over kv blocks."""
     qi = pl.program_id(1)
@@ -276,7 +295,10 @@ def _flash_bwd_dq_kernel(
             k_pos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            visible = q_pos >= k_pos
+            if window is not None:
+                visible = visible & (q_pos - k_pos < window)
+            s = jnp.where(visible, s, NEG_INF)
             # exp(s - lse); fully-masked rows have lse ~ NEG_INF — zero.
             p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         else:
@@ -291,7 +313,8 @@ def _flash_bwd_dq_kernel(
         )
 
     _causal_dispatch(
-        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset,
+        window=window,
     )
 
     @pl.when(ki == num_k - 1)
@@ -317,6 +340,7 @@ def _flash_bwd_dkv_kernel(
     block_k: int,
     q_offset: int,
     kv_offset: int,
+    window=None,
 ):
     """dV = Pᵀ dO and dK = dSᵀ Q · scale, accumulated over q blocks."""
     ki = pl.program_id(1)
@@ -346,7 +370,10 @@ def _flash_bwd_dkv_kernel(
             k_pos = kv_offset + ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            visible = q_pos >= k_pos
+            if window is not None:
+                visible = visible & (q_pos - k_pos < window)
+            s = jnp.where(visible, s, NEG_INF)
             p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         else:
             p = jnp.exp(s - lse)
@@ -364,7 +391,8 @@ def _flash_bwd_dkv_kernel(
         )  # dsᵀ @ q (un-normalized; scale applied at finalize)
 
     _causal_dispatch(
-        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset
+        _compute, causal, qi, ki, block_q, block_k, q_offset, kv_offset,
+        window=window,
     )
 
     @pl.when(qi == num_q - 1)
@@ -391,7 +419,7 @@ def _lse_delta_lanes(o, lse, do):
 def _flash_backward_pallas(
     q, k, v, o, lse, do, *, scale: float, causal: bool,
     block_q: int, block_k: int, q_offset: int, kv_offset: int, interpret: bool,
-    lse_delta_b=None, out_dtype=None,
+    lse_delta_b=None, out_dtype=None, window=None,
 ):
     """Pallas flash backward on [BH, T, D] inputs → (dq, dk, dv).
 
@@ -426,6 +454,7 @@ def _flash_backward_pallas(
         block_k=block_k,
         q_offset=q_offset,
         kv_offset=kv_offset,
+        window=window,
     )
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -474,13 +503,15 @@ def _flash_backward_pallas(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
 def _flash_bthd(
-    q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret
+    q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret,
+    window,
 ):
     out, _ = _flash_fwd_bthd(
-        q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret
+        q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset,
+        interpret, window,
     )
     return out
 
@@ -496,7 +527,8 @@ def _bht_to_bthd(x, b, h):  # [B*H, T, D] -> [B,T,H,D]
 
 
 def _flash_fwd_bthd(
-    q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret
+    q, k, v, scale, causal, block_q, block_k, q_offset, kv_offset, interpret,
+    window,
 ):
     b, t, h, d = q.shape
     o, lse = _flash_forward(
@@ -510,13 +542,15 @@ def _flash_fwd_bthd(
         q_offset=q_offset,
         kv_offset=kv_offset,
         interpret=interpret,
+        window=window,
     )
     out = _bht_to_bthd(o, b, h)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_bthd(
-    scale, causal, block_q, block_k, q_offset, kv_offset, interpret, res, g
+    scale, causal, block_q, block_k, q_offset, kv_offset, interpret, window,
+    res, g,
 ):
     q, k, v, out, lse = res
     b, t, h, d = q.shape
@@ -534,6 +568,7 @@ def _flash_bwd_bthd(
         q_offset=q_offset,
         kv_offset=kv_offset,
         interpret=interpret,
+        window=window,
     )
     return _bht_to_bthd(dq, b, h), _bht_to_bthd(dk, b, h), _bht_to_bthd(dv, b, h)
 
@@ -559,6 +594,7 @@ def flash_attention(
     kv_offset: int = 0,
     mask: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Tiled flash attention, BTHD layout — drop-in for
     :func:`rayfed_tpu.ops.attention.dot_product_attention` (also as the
@@ -569,12 +605,22 @@ def flash_attention(
     supported by the tiled kernel — use ``dot_product_attention``.
     ``interpret=None`` auto-selects the pallas interpreter off-TPU so the
     same code path runs on the CPU test mesh.
+
+    ``window`` (static, requires ``causal=True``): sliding-window
+    attention — query q sees keys in ``(q − window, q]`` (Mistral
+    style).  kv blocks entirely outside the band are skipped, so FLOPs
+    scale with O(T·window) instead of the causal triangle.
     """
     if mask is not None:
         raise ValueError(
             "flash_attention does not support a dense mask; use "
             "dot_product_attention (or causal=True with offsets)"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError("window= requires causal=True (Mistral SWA)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = not _on_tpu()
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
@@ -594,6 +640,7 @@ def flash_attention(
     return _flash_bthd(
         q, k, v, scale, causal, block_q, block_k,
         int(q_offset), int(kv_offset), interpret,
+        None if window is None else int(window),
     )
 
 

@@ -189,6 +189,57 @@ def test_run_fedavg_rounds_compress_wire():
     run_parties(_run_compressed, ["alice", "bob"], args=(COMPRESS_CLUSTER,))
 
 
+FAIL_CLUSTER = make_cluster(["alice", "bob"])
+
+
+def _run_trainer_failure(party, cluster=FAIL_CLUSTER):
+    """A trainer that raises mid-round surfaces RemoteError through the
+    round loop on BOTH parties (the failed producer poisons its promised
+    keys), instead of parking the peer until the recv backstop."""
+    import time
+
+    import jax
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.exceptions import RemoteError
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    fed.init(address="local", cluster=cluster, party=party)
+
+    @fed.remote
+    class Flaky:
+        def __init__(self, should_fail):
+            self._fail = should_fail
+            self._n = 0
+
+        def train(self, params):
+            self._n += 1
+            if self._fail and self._n >= 2:
+                raise RuntimeError("silo data corrupted at round 2")
+            return jax.tree_util.tree_map(lambda x: x + 1.0, params)
+
+    trainers = {
+        "alice": Flaky.party("alice").remote(False),
+        "bob": Flaky.party("bob").remote(True),
+    }
+    t0 = time.monotonic()
+    with pytest.raises((RemoteError, RuntimeError)) as ei:
+        run_fedavg_rounds(
+            trainers, {"w": jax.numpy.zeros((3,))}, rounds=4,
+        )
+    # Fail fast, not after the 3600s recv backstop; the message names
+    # the producer's error on whichever side observes it.
+    assert time.monotonic() - t0 < 60
+    assert "corrupted" in str(ei.value), ei.value
+    fed.shutdown()
+
+
+def test_run_fedavg_rounds_surfaces_trainer_failure():
+    run_parties(
+        _run_trainer_failure, ["alice", "bob"], args=(FAIL_CLUSTER,)
+    )
+
+
 def test_run_fedavg_rounds_validation():
     from rayfed_tpu.fl import run_fedavg_rounds
 

@@ -158,3 +158,56 @@ def test_flash_partially_masked_rows():
     for gf, gd in zip(g_flash, g_dense):
         np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)
         assert not np.any(np.isnan(gf))
+
+
+def _band_mask(t, window):
+    q_pos = np.arange(t)[:, None]
+    k_pos = np.arange(t)[None, :]
+    return jnp.asarray((q_pos >= k_pos) & (q_pos - k_pos < window))
+
+
+@pytest.mark.parametrize("window", [1, 8, 24])
+def test_flash_window_matches_dense(window):
+    """Sliding-window attention equals dense attention under the same
+    band mask — including the window=1 (self-only) edge."""
+    q, k, v = _qkv(jax.random.PRNGKey(7))
+    t = q.shape[1]
+    out = flash_attention(
+        q, k, v, causal=True, window=window, block_q=16, block_k=16
+    )
+    expected = dot_product_attention(
+        q, k, v, mask=_band_mask(t, window)[None, None]
+    )
+    np.testing.assert_allclose(out, expected, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_window_gradients():
+    q, k, v = _qkv(jax.random.PRNGKey(8), t=48)
+    t, window = q.shape[1], 12
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, window=window, block_q=16, block_k=16
+            )
+            ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, mask=_band_mask(t, window)[None, None])
+            ** 2
+        )
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_window_validation():
+    q, k, v = _qkv(jax.random.PRNGKey(9), t=16)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, window=8)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=0)
