@@ -1067,6 +1067,17 @@ def bench_flash() -> dict:
     args4k = shape(2, 4096)
     dense4k = timed(dot_product_attention, *args4k, n_long=32)
     flash4k = timed(flash_attention, *args4k, n_long=32)
+    # Sliding window at T=4096, W=1024: out-of-band kv blocks never
+    # launch.  At the default 1024-wide blocks the 4×4 grid keeps 7 of
+    # the causal path's 10 blocks (diagonal + one sub-diagonal), so the
+    # expected speedup here is ~10/7 ≈ 1.4× — smaller blocks or larger
+    # T/W ratios approach the asymptotic O(T·W).
+    _log("  compiling windowed flash chain (T=4096, W=1024)...")
+    import functools as _ft
+
+    swa4k = timed(
+        _ft.partial(flash_attention, window=1024), *args4k, n_long=32
+    )
     return {
         "flash_speedup": round(dense_t / flash_t, 3),
         "flash_ms": round(flash_t * 1e3, 2),
@@ -1074,6 +1085,8 @@ def bench_flash() -> dict:
         "flash_speedup_t4096": round(dense4k / flash4k, 3),
         "flash_ms_t4096": round(flash4k * 1e3, 2),
         "dense_ms_t4096": round(dense4k * 1e3, 2),
+        "flash_window_ms_t4096": round(swa4k * 1e3, 2),
+        "flash_window_speedup": round(flash4k / swa4k, 3),
     }
 
 

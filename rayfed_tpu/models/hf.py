@@ -98,6 +98,9 @@ def config_from_hf(hf_config) -> LlamaConfig:
         rms_eps=float(hf_config.rms_norm_eps),
         max_seq_len=int(hf_config.max_position_embeddings),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        # Mistral-style band (None on plain Llama configs) — supported
+        # natively, so map rather than reject.
+        sliding_window=getattr(hf_config, "sliding_window", None),
         dtype=jnp.float32,
         param_dtype=jnp.float32,
     )

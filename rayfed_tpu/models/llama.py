@@ -68,8 +68,18 @@ class LlamaConfig:
     # scales fold into the score/probability tensors, so the cache is
     # read as raw int8 (see make_decode_step).
     kv_quant: bool = False
+    # Sliding-window attention (Mistral style): each query sees only its
+    # last `sliding_window` keys.  Applied uniformly by the training
+    # forward, prefill, AND the decode step's cache mask; with the flash
+    # attn_fn the out-of-band kv blocks are skipped in the kernel grid
+    # (O(T·W) FLOPs).
+    sliding_window: Optional[int] = None
 
     def __post_init__(self):
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}"
+            )
         if self.remat_policy not in (None, "dots"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r} "
@@ -316,7 +326,13 @@ def _layer_fwd(x, lp, config, cos, sin, attn_fn, b, t, lget=_no_lora,
         reps = h // kv
         k = jnp.repeat(k, reps, axis=2)
         v = jnp.repeat(v, reps, axis=2)
-    attn = attn_fn(q, k, v, causal=True)
+    if config.sliding_window is not None:
+        # Both dense and flash attn_fns accept window=; an attn_fn that
+        # cannot honor it (ring/Ulysses wrappers) fails loudly here
+        # rather than silently attending outside the band.
+        attn = attn_fn(q, k, v, causal=True, window=config.sliding_window)
+    else:
+        attn = attn_fn(q, k, v, causal=True)
     x = _attn_out(x, attn, lp, config, b, t, lget)
     x = _mlp_block(x, lp, config, lget)
     return (x, (k_out, v_out)) if emit_kv else (x, None)
@@ -473,8 +489,12 @@ def make_decode_step(config: LlamaConfig):
         max_len = cache["k"].shape[2]
         x = params["embed"].astype(dtype)[token_ids][:, None, :]  # [B,1,D]
         cos, sin = rope_tables(pos[None], dh, config.rope_theta)
-        # Valid-length mask over the static cache: positions <= pos.
-        valid = jnp.arange(max_len) <= pos  # [T]
+        # Valid-length mask over the static cache: positions <= pos
+        # (and, under sliding-window attention, within the band).
+        positions = jnp.arange(max_len)
+        valid = positions <= pos  # [T]
+        if config.sliding_window is not None:
+            valid = valid & (positions > pos - config.sliding_window)
 
         def layer_body(x, scanned):
             lp = scanned["w"]

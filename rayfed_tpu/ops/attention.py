@@ -35,13 +35,22 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,
     q_offset: int = 0,
     kv_offset: int = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Plain softmax attention, BTHD layout.
 
     ``q_offset``/``kv_offset`` are the global positions of the first query
     / key token — used when q and k are shards of a longer sequence (the
-    causal mask must compare *global* positions).
+    causal mask must compare *global* positions).  ``window`` (requires
+    ``causal``) restricts each query to its last ``window`` keys.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window= requires causal=True")
+        if window < 1:
+            # Same contract as flash_attention: window=0 would mask every
+            # score, and softmax of an all-NEG_INF row is silently uniform.
+            raise ValueError(f"window must be >= 1, got {window}")
     orig_dtype = q.dtype
     head_dim = q.shape[-1]
     scale = sm_scale if sm_scale is not None else head_dim**-0.5
@@ -51,6 +60,10 @@ def dot_product_attention(
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = kv_offset + jnp.arange(k.shape[1])
         causal_mask = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            causal_mask = causal_mask & (
+                q_pos[:, None] - k_pos[None, :] < window
+            )
         s = jnp.where(causal_mask[None, None, :, :], s, NEG_INF)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)

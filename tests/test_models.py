@@ -260,6 +260,42 @@ def test_llama_kv_cache_decode_matches_full_forward():
     )
 
 
+def test_llama_sliding_window_forward_and_decode():
+    """config.sliding_window applies uniformly: the training forward
+    (dense and flash attn_fn agree) and the KV-cache decode step produce
+    identical logits, and differ from the unwindowed model."""
+    cfg_full = llama.llama_tiny()
+    cfg = llama.llama_tiny(sliding_window=4)
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg_full)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+
+    ref = llama.apply_llama(params, ids, cfg)
+    via_flash = llama.apply_llama(
+        params, ids, cfg,
+        attn_fn=lambda q, k, v, **kw: flash_attention(
+            q, k, v, block_q=8, block_k=8, **kw
+        ),
+    )
+    np.testing.assert_allclose(
+        np.asarray(via_flash), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+
+    cache = llama.init_kv_cache(cfg, 2, 12)
+    step = llama.make_decode_step(cfg)
+    outs = []
+    for t in range(12):
+        cache, logits = step(params, cache, ids[:, t], t)
+        outs.append(logits)
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(dec), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+    # The window genuinely restricts attention (t=11 sees only 8..11).
+    full = llama.apply_llama(params, ids, cfg_full)
+    assert not np.allclose(np.asarray(ref[:, -1]), np.asarray(full[:, -1]))
+
+
 def test_llama_kv_quant_decode_close_and_compact():
     """int8 KV cache: decode logits track the exact forward closely
     (int8 error budget), greedy choices almost always agree, and the
