@@ -465,8 +465,39 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Params:
     }
 
 
+def init_rolling_kv_cache(config: LlamaConfig, batch: int) -> Params:
+    """Ring-buffer cache of exactly ``sliding_window`` slots — decode
+    memory stays O(W) for unbounded generation (pair with
+    ``make_decode_step(config, rolling=True)``)."""
+    if config.sliding_window is None:
+        raise ValueError("a rolling cache requires config.sliding_window")
+    return init_kv_cache(config, batch, config.sliding_window)
+
+
+def roll_kv_cache(cache: Params, config: LlamaConfig, t0: int) -> Params:
+    """Re-layout a (prefilled) linear cache into the rolling ring buffer.
+
+    ``t0``: tokens already in the cache (prefill length).  Slot ``i`` of
+    the ring receives the newest cached position congruent to ``i`` mod
+    W; slots whose position would be negative (``t0 < W``) hold garbage
+    that the rolling step's validity arithmetic masks out.
+    """
+    w = config.sliding_window
+    if w is None:
+        raise ValueError("roll_kv_cache requires config.sliding_window")
+    max_len = cache["k"].shape[2]
+    last = t0 - 1
+    slots = jnp.arange(w)
+    src = last - jnp.mod(last - slots, w)  # abs position for slot i
+    src_idx = jnp.clip(src, 0, max_len - 1)
+    return {
+        name: jnp.take(plane, src_idx, axis=2)
+        for name, plane in cache.items()
+    }
+
+
 @functools.lru_cache(maxsize=None)
-def make_decode_step(config: LlamaConfig):
+def make_decode_step(config: LlamaConfig, rolling: bool = False):
     """One-token autoregressive step as a single jitted program.
 
     Returns ``step(params, cache, token_ids, pos) -> (cache, logits)``:
@@ -479,7 +510,18 @@ def make_decode_step(config: LlamaConfig):
     RoPE tables, f32 softmax over the masked cache, bf16 MXU matmuls
     with f32 accumulation for the lm_head.  Cached per config (frozen
     dataclass) so repeat callers reuse the compiled program.
+
+    ``rolling`` (requires ``config.sliding_window``): the cache is a
+    ring buffer of exactly ``W`` slots (:func:`init_rolling_kv_cache`)
+    — token ``pos`` writes slot ``pos % W``, and slot validity falls out
+    of the ring arithmetic (a slot is live iff its absolute position is
+    ≥ 0; the band and causality are automatic because every resident
+    position lies in ``(pos − W, pos]``).  Memory stays O(W) for
+    unbounded generation; k/v carry RoPE at their absolute positions,
+    so scores need no relocation when slots are overwritten.
     """
+    if rolling and config.sliding_window is None:
+        raise ValueError("rolling=True requires config.sliding_window")
     h, kvh, dh = config.num_heads, config.num_kv_heads, config.head_dim
     dtype = config.dtype
 
@@ -489,12 +531,29 @@ def make_decode_step(config: LlamaConfig):
         max_len = cache["k"].shape[2]
         x = params["embed"].astype(dtype)[token_ids][:, None, :]  # [B,1,D]
         cos, sin = rope_tables(pos[None], dh, config.rope_theta)
-        # Valid-length mask over the static cache: positions <= pos
-        # (and, under sliding-window attention, within the band).
         positions = jnp.arange(max_len)
-        valid = positions <= pos  # [T]
-        if config.sliding_window is not None:
-            valid = valid & (positions > pos - config.sliding_window)
+        if rolling:
+            # The ring modulus IS the window; a linear cache passed here
+            # by mistake (skipping roll_kv_cache) would silently widen
+            # the attention window — reject it at trace time.
+            if max_len != config.sliding_window:
+                raise ValueError(
+                    f"rolling decode needs a {config.sliding_window}-slot "
+                    f"ring cache (init_rolling_kv_cache/roll_kv_cache), "
+                    f"got {max_len} slots"
+                )
+            # Slot i holds absolute position pos − ((pos − i) mod W);
+            # live iff that position is ≥ 0.
+            write_pos = jnp.mod(pos, max_len)
+            abs_pos = pos - jnp.mod(pos - positions, max_len)
+            valid = abs_pos >= 0
+        else:
+            # Valid-length mask over the static cache: positions <= pos
+            # (and, under sliding-window attention, within the band).
+            write_pos = pos
+            valid = positions <= pos  # [T]
+            if config.sliding_window is not None:
+                valid = valid & (positions > pos - config.sliding_window)
 
         def layer_body(x, scanned):
             lp = scanned["w"]
@@ -510,23 +569,23 @@ def make_decode_step(config: LlamaConfig):
                 k_q, k_s = _quantize_kv(k)
                 v_q, v_s = _quantize_kv(v)
                 k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k_q, (0, pos, 0, 0)
+                    k_cache, k_q, (0, write_pos, 0, 0)
                 )
                 v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v_q, (0, pos, 0, 0)
+                    v_cache, v_q, (0, write_pos, 0, 0)
                 )
                 out_cache["k_scale"] = jax.lax.dynamic_update_slice(
-                    scanned["k_scale"], k_s, (0, pos, 0, 0)
+                    scanned["k_scale"], k_s, (0, write_pos, 0, 0)
                 )
                 out_cache["v_scale"] = jax.lax.dynamic_update_slice(
-                    scanned["v_scale"], v_s, (0, pos, 0, 0)
+                    scanned["v_scale"], v_s, (0, write_pos, 0, 0)
                 )
             else:
                 k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
+                    k_cache, k.astype(k_cache.dtype), (0, write_pos, 0, 0)
                 )
                 v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
+                    v_cache, v.astype(v_cache.dtype), (0, write_pos, 0, 0)
                 )
             # GQA: group query heads over the shared kv head (g = H/KV).
             # Native-dtype (bf16) MXU operands with f32 accumulation —

@@ -296,6 +296,63 @@ def test_llama_sliding_window_forward_and_decode():
     assert not np.allclose(np.asarray(ref[:, -1]), np.asarray(full[:, -1]))
 
 
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_llama_rolling_cache_matches_linear(kv_quant):
+    """The O(W) ring-buffer decode reproduces the linear sliding-window
+    decode exactly — prefill, conversion, and many overwrite cycles —
+    composing with the int8 cache (scales roll with their planes)."""
+    cfg = llama.llama_tiny(sliding_window=4, kv_quant=kv_quant)
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    t0, n_new = 6, 10
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, t0), 0, cfg.vocab_size)
+    max_len = t0 + n_new
+
+    cache_lin, logits_lin = llama.prefill(params, cfg, ids, max_len)
+    step_lin = llama.make_decode_step(cfg)
+
+    cache_roll = llama.roll_kv_cache(cache_lin, cfg, t0)
+    assert cache_roll["k"].shape[2] == 4  # O(W) memory
+    step_roll = llama.make_decode_step(cfg, rolling=True)
+
+    logits_roll = logits_lin
+    tok = jnp.argmax(logits_lin, axis=-1).astype(ids.dtype)
+    for i in range(n_new):
+        cache_lin, logits_lin = step_lin(params, cache_lin, tok, t0 + i)
+        cache_roll, logits_roll = step_roll(params, cache_roll, tok, t0 + i)
+        np.testing.assert_allclose(
+            np.asarray(logits_roll), np.asarray(logits_lin),
+            rtol=2e-4, atol=2e-4,
+        )
+        tok = jnp.argmax(logits_lin, axis=-1).astype(ids.dtype)
+
+
+def test_llama_rolling_cache_short_prompt():
+    """t0 < W: unwritten ring slots must be masked, not attended."""
+    cfg = llama.llama_tiny(sliding_window=8)
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 3), 0, cfg.vocab_size)
+    cache_lin, logits = llama.prefill(params, cfg, ids, 16)
+    cache_roll = llama.roll_kv_cache(cache_lin, cfg, 3)
+    step_lin = llama.make_decode_step(cfg)
+    step_roll = llama.make_decode_step(cfg, rolling=True)
+    tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+    for i in range(5):
+        cache_lin, l_lin = step_lin(params, cache_lin, tok, 3 + i)
+        cache_roll, l_roll = step_roll(params, cache_roll, tok, 3 + i)
+        np.testing.assert_allclose(
+            np.asarray(l_roll), np.asarray(l_lin), rtol=2e-4, atol=2e-4
+        )
+        tok = jnp.argmax(l_lin, axis=-1).astype(ids.dtype)
+
+
+def test_llama_rolling_requires_window():
+    cfg = llama.llama_tiny()
+    with pytest.raises(ValueError, match="sliding_window"):
+        llama.make_decode_step(cfg, rolling=True)
+    with pytest.raises(ValueError, match="sliding_window"):
+        llama.init_rolling_kv_cache(cfg, 1)
+
+
 def test_llama_kv_quant_decode_close_and_compact():
     """int8 KV cache: decode logits track the exact forward closely
     (int8 error budget), greedy choices almost always agree, and the
