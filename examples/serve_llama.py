@@ -1,0 +1,64 @@
+"""Single-chip Llama serving: int8 weights, sliding window, rolling cache.
+
+Demonstrates the inference stack end-to-end on a tiny config (swap in
+``llama3_8b()`` + ``from_hf_llama`` weights on a real chip):
+
+1. int8-quantize the base (half the HBM reads per token);
+2. batched prefill of the prompt;
+3. token-at-a-time decode through an O(window) rolling KV cache —
+   memory stays constant no matter how long the generation runs.
+
+    JAX_PLATFORMS=cpu python examples/serve_llama.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PROMPT_LEN = 12
+NEW_TOKENS = 24
+WINDOW = 16
+
+
+def run(new_tokens: int = NEW_TOKENS) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from rayfed_tpu.models import llama
+
+    cfg = llama.llama_tiny(sliding_window=WINDOW, kv_quant=True)
+    params = llama.quantize_llama_base(
+        llama.init_llama(jax.random.PRNGKey(0), cfg)
+    )
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (2, PROMPT_LEN), 0, cfg.vocab_size
+    )
+
+    # Prefill at prompt length, then shrink to the O(window) ring.
+    cache, logits = llama.prefill(params, cfg, prompt, PROMPT_LEN)
+    cache = llama.roll_kv_cache(cache, cfg, PROMPT_LEN)
+    step = llama.make_decode_step(cfg, rolling=True)
+
+    cache_mb = sum(v.nbytes for v in cache.values()) / 1e6
+    tokens = []
+    tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    for i in range(new_tokens):
+        tokens.append(tok)
+        cache, logits = step(params, cache, tok, PROMPT_LEN + i)
+        tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+    out = jnp.stack(tokens, axis=1)
+    print(
+        f"served {out.shape[0]}x{out.shape[1]} tokens; int8 base, "
+        f"W={WINDOW} rolling cache pinned at {cache_mb:.3f} MB "
+        f"(independent of generation length)",
+        flush=True,
+    )
+    assert out.shape == (2, new_tokens)
+    return int(out.shape[1])
+
+
+if __name__ == "__main__":
+    run()

@@ -1,7 +1,8 @@
-"""The shipped examples must stay runnable: CI drives each example's
-``run()`` in real per-party processes (same code path as
-``python examples/<name>.py``), so the files the docs point users at
-cannot silently drift from the tested behavior."""
+"""The shipped examples must stay runnable: CI drives each federated
+example's ``run()`` in real per-party processes (same code path as
+``python examples/<name>.py``) and the single-process serving example
+in-process, so the files the docs point users at cannot silently drift
+from the tested behavior."""
 
 from tests.multiproc import run_parties
 
@@ -22,3 +23,9 @@ def test_lora_finetune_example():
 
 def test_split_fl_bert_example():
     run_parties(run_split_example, ["alice", "bob"], args=(2,), timeout=240)
+
+
+def test_serve_llama_example():
+    from examples.serve_llama import run as run_serve_example
+
+    assert run_serve_example(8) == 8
