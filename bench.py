@@ -400,7 +400,22 @@ def _run_resnet_party(party: str, result_q) -> None:
     bundle = fed.get(do_round(bundle))
     jax.block_until_ready(jax.tree_util.tree_leaves(bundle)[0])
 
+    from rayfed_tpu import metrics
+    from rayfed_tpu.runtime import get_runtime_or_none
+
+    def _drain_sends():
+        # Barrier on in-flight sends (peers' fed.get triggers pushes on
+        # transport threads): without it the warmup's broadcast could
+        # land inside the decomposition window — and the final round's
+        # trailing pushes outside it.  The watchdog restarts on the next
+        # tracked send.
+        cm = get_runtime_or_none().cleanup_manager
+        if cm is not None:
+            cm.wait_sending()
+
+    _drain_sends()
     rounds = RESNET_ROUNDS
+    total0 = metrics.get_transfer_log().total_recorded
     t0 = time.perf_counter()
     obj = do_round(bundle)
     for _ in range(rounds - 1):
@@ -408,11 +423,26 @@ def _run_resnet_party(party: str, result_q) -> None:
     bundle = fed.get(obj)
     jax.block_until_ready(jax.tree_util.tree_leaves(bundle)[0])
     elapsed = time.perf_counter() - t0
+    _drain_sends()
+
+    # Per-round wire decomposition, this party's view (split-bench
+    # pattern) — on the coordinator this is the aggregation leg's cost.
+    recs, complete = metrics.get_transfer_log().records_since(total0)
+    if complete:
+        read_ms = sum(r.seconds for r in recs if r.direction == "recv") / rounds * 1e3
+        send_ms = sum(r.seconds for r in recs if r.direction == "send") / rounds * 1e3
+    else:  # ring evicted part of the window
+        read_ms = send_ms = float("nan")
 
     # Coordinator topology: (N-1) contributions in + (N-1) results out.
     wire_bytes = 2 * (len(RESNET_PARTIES) - 1) * bundle_bytes * rounds
     if result_q is not None:
-        result_q.put((party, (rounds / elapsed, wire_bytes / elapsed / 1e9)))
+        result_q.put(
+            (
+                party,
+                (rounds / elapsed, wire_bytes / elapsed / 1e9, read_ms, send_ms),
+            )
+        )
     fed.shutdown()
 
 
@@ -1355,7 +1385,15 @@ def main() -> None:
         xgbps = sum(v[1] for v in res.values()) / len(res)
         extra["resnet_4party_rounds_per_sec"] = round(rps, 3)
         extra["cross_party_GBps"] = round(xgbps, 3)
-        _log(f"  resnet: {rps:.3f} rounds/s, {xgbps:.3f} GB/s cross-party")
+        # Coordinator's per-round wire decomposition (alice aggregates).
+        coord = res.get("alice", next(iter(res.values())))
+        extra["resnet_coord_wire_read_ms"] = round(coord[2], 2)
+        extra["resnet_coord_send_path_ms"] = round(coord[3], 2)
+        _log(
+            f"  resnet: {rps:.3f} rounds/s, {xgbps:.3f} GB/s cross-party; "
+            f"coordinator wire-read {coord[2]:.1f} ms + send "
+            f"{coord[3]:.1f} ms per round"
+        )
         _settle()
 
         # North-star ratio (BASELINE.json #3): fedavg vs the single-
@@ -1406,6 +1444,12 @@ def main() -> None:
         }
 
     record.update(extra)
+    # NaN (e.g. a ring-evicted decomposition window) is not valid JSON;
+    # map it to null so strict parsers accept every BENCH line.
+    record = {
+        k: (None if isinstance(v, float) and v != v else v)
+        for k, v in record.items()
+    }
     print(json.dumps(record), flush=True)
 
 
