@@ -60,8 +60,18 @@ def run(party: str, rounds: int = ROUNDS) -> float:
     bob = Trainer.party("bob").remote(2)
 
     params = logistic.init_logistic(jax.random.PRNGKey(0), D, CLASSES)
+
+    # The explicit loop (how the pieces compose):
     for _ in range(rounds):
         params = aggregate([alice.train.remote(params), bob.train.remote(params)])
+
+    # ...or the one-call driver, which also pipelines rounds and can add
+    # a server optimizer / checkpointing (see docs "Federated averaging").
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    params = run_fedavg_rounds(
+        {"alice": alice, "bob": bob}, params, rounds=rounds
+    )
 
     acc = fed.get(alice.accuracy.remote(params))
     print(f"[{party}] final train accuracy@alice: {acc:.3f}", flush=True)
