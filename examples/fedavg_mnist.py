@@ -59,19 +59,22 @@ def run(party: str, rounds: int = ROUNDS) -> float:
     alice = Trainer.party("alice").remote(1)
     bob = Trainer.party("bob").remote(2)
 
-    params = logistic.init_logistic(jax.random.PRNGKey(0), D, CLASSES)
+    params0 = logistic.init_logistic(jax.random.PRNGKey(0), D, CLASSES)
 
     # The explicit loop (how the pieces compose):
+    params = params0
     for _ in range(rounds):
         params = aggregate([alice.train.remote(params), bob.train.remote(params)])
 
-    # ...or the one-call driver, which also pipelines rounds and can add
-    # a server optimizer / checkpointing (see docs "Federated averaging").
+    # ...or, equivalently, the one-call driver from the same start — it
+    # also pipelines rounds and can add a server optimizer /
+    # checkpointing (see docs "Federated averaging").
     from rayfed_tpu.fl import run_fedavg_rounds
 
-    params = run_fedavg_rounds(
-        {"alice": alice, "bob": bob}, params, rounds=rounds
+    via_driver = run_fedavg_rounds(
+        {"alice": alice, "bob": bob}, params0, rounds=rounds
     )
+    assert jnp.allclose(via_driver["w"], params["w"], atol=1e-5)
 
     acc = fed.get(alice.accuracy.remote(params))
     print(f"[{party}] final train accuracy@alice: {acc:.3f}", flush=True)
