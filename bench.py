@@ -409,7 +409,8 @@ def _run_resnet_party(party: str, result_q) -> None:
         # land inside the decomposition window — and the final round's
         # trailing pushes outside it.  The watchdog restarts on the next
         # tracked send.
-        cm = get_runtime_or_none().cleanup_manager
+        rt = get_runtime_or_none()
+        cm = rt.cleanup_manager if rt is not None else None
         if cm is not None:
             cm.wait_sending()
 

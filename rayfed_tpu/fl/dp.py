@@ -10,7 +10,14 @@ identically by every party to its own update before the push.
 
 Composes with :mod:`rayfed_tpu.fl.secure`: clip first (secure
 aggregation needs bounded values anyway), noise, then mask — the server
-only ever sees the noised sum.
+only ever sees the noised sum.  Mind the ranges when composing:
+``mask_update``'s fixed-point encode re-clips per-coordinate at its
+``clip`` (default ±8), and Gaussian noise with σ = noise_multiplier ·
+clip_norm can exceed that range and be truncated, biasing the sum and
+weakening the stated DP mechanism.  Use :func:`secure_clip_for` to pick
+a safe fixed-point range (it is validated by
+:func:`check_secure_composition`, which :func:`privatize` cannot run
+for you because it never sees the fixed-point clip).
 
 All jit-compiled pytree arithmetic; noise is drawn on-device from a
 party-held PRNG key.
@@ -50,6 +57,47 @@ def clip_by_global_norm(tree: Any, clip_norm: float) -> Tuple[Any, jax.Array]:
         tree,
     )
     return clipped, norm
+
+
+def secure_clip_for(
+    *, clip_norm: float, noise_multiplier: float, tail_sds: float = 6.0
+) -> float:
+    """Fixed-point ``clip`` for ``fl.secure.mask_update`` after ``privatize``.
+
+    A privatized coordinate is bounded by ``clip_norm`` (global-L2
+    clipping bounds every coordinate) plus Gaussian noise of
+    σ = ``noise_multiplier · clip_norm``; ``tail_sds`` standard
+    deviations of headroom (default 6 → per-coordinate truncation
+    probability ~1e-9) keeps the fixed-point encode from re-clipping
+    the noise and biasing the sum.
+    """
+    sigma = noise_multiplier * clip_norm
+    return clip_norm + tail_sds * sigma
+
+
+def check_secure_composition(
+    *,
+    clip_norm: float,
+    noise_multiplier: float,
+    secure_clip: float,
+    tail_sds: float = 4.0,
+) -> None:
+    """Raise if ``mask_update(clip=secure_clip)`` would truncate DP noise.
+
+    Call with the values you pass to :func:`privatize` and to
+    ``fl.secure.mask_update``; raises ``ValueError`` when the
+    fixed-point range leaves fewer than ``tail_sds`` noise standard
+    deviations of headroom above ``clip_norm``.
+    """
+    needed = clip_norm + tail_sds * noise_multiplier * clip_norm
+    if secure_clip < needed:
+        raise ValueError(
+            f"secure-aggregation fixed-point clip {secure_clip} would "
+            f"truncate DP noise (clip_norm={clip_norm}, "
+            f"sigma={noise_multiplier * clip_norm:.4g}): need >= {needed:.4g} "
+            f"({tail_sds} standard deviations of headroom); use "
+            f"secure_clip_for(...) or raise mask_update's clip="
+        )
 
 
 def privatize(

@@ -52,7 +52,9 @@ def run_fedavg_rounds(
       tree flows straight back into ``train``; the driver decompresses
       only what it returns or feeds the server optimizer.
     - ``checkpointer``: a :class:`rayfed_tpu.checkpoint.FedCheckpointer`;
-      resume happens automatically from its latest complete round.
+      resume happens automatically from its latest complete round.  If
+      ``checkpoint_every`` is left at 0, it defaults to 1 (every round)
+      — a checkpointer that resumes but never saves is a misconfig.
     - ``on_round(i, params)``: called after each materialized round.
 
     Without a server optimizer the rounds **pipeline**: the averaged
@@ -67,6 +69,12 @@ def run_fedavg_rounds(
         raise ValueError(f"rounds must be >= 1, got {rounds}")
     if checkpoint_every and checkpointer is None:
         raise ValueError("checkpoint_every set without a checkpointer")
+    if checkpoint_every < 0:
+        raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+    if checkpointer is not None and not checkpoint_every:
+        # A checkpointer with checkpoint_every=0 would resume but never
+        # save — snapshot every round rather than silently never.
+        checkpoint_every = 1
 
     from rayfed_tpu.fed_object import FedObject
 

@@ -84,10 +84,19 @@ def as_attn_fn(sharded, built_causal: bool, built_scale, builder: str):
     rejects *conflicting* values instead of silently ignoring them.
     """
 
-    def apply(q, k, v, *, causal=None, sm_scale=None, mask=None):
+    def apply(q, k, v, *, causal=None, sm_scale=None, mask=None, window=None):
         if mask is not None:
             raise ValueError(
                 f"{builder} attention does not support a dense mask"
+            )
+        if window is not None:
+            # Accepted-then-rejected so LlamaConfig(sliding_window=...)
+            # with a ring/Ulysses attn_fn fails with this explanation,
+            # not a bare unexpected-keyword TypeError.
+            raise ValueError(
+                f"{builder} attention does not support sliding-window "
+                f"attention (window={window}); drop sliding_window or use "
+                f"the flash/dense attention path"
             )
         if causal is not None and bool(causal) != built_causal:
             raise ValueError(

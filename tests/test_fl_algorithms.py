@@ -224,6 +224,27 @@ def test_privatize_noise_scale():
     np.testing.assert_array_equal(np.asarray(out0["w"]), np.asarray(tree["w"]))
 
 
+def test_secure_composition_range_check():
+    from rayfed_tpu.fl.dp import check_secure_composition, secure_clip_for
+
+    # The default mask_update clip (±8) truncates noise at sigma=4.
+    with pytest.raises(ValueError, match="truncate DP noise"):
+        check_secure_composition(
+            clip_norm=4.0, noise_multiplier=1.0, secure_clip=8.0
+        )
+    # secure_clip_for picks a range the check accepts (it uses more
+    # tail headroom than the check demands).
+    safe = secure_clip_for(clip_norm=4.0, noise_multiplier=1.0)
+    assert safe == pytest.approx(4.0 + 6 * 4.0)
+    check_secure_composition(
+        clip_norm=4.0, noise_multiplier=1.0, secure_clip=safe
+    )
+    # Noise-free clipping inside the range passes.
+    check_secure_composition(
+        clip_norm=4.0, noise_multiplier=0.0, secure_clip=8.0
+    )
+
+
 # ---------------------------------------------------------------------------
 # 2-party integration: secure aggregation over the real transport
 # ---------------------------------------------------------------------------

@@ -247,3 +247,36 @@ def test_run_fedavg_rounds_validation():
         run_fedavg_rounds({}, {}, rounds=0)
     with pytest.raises(ValueError, match="checkpointer"):
         run_fedavg_rounds({}, {}, rounds=1, checkpoint_every=2)
+
+
+def test_run_fedavg_rounds_checkpointer_defaults_every_round(tmp_path):
+    # A checkpointer with checkpoint_every left at 0 must still save
+    # (defaults to every round) — resume-but-never-save is a misconfig.
+    import jax
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.checkpoint import FedCheckpointer
+    from rayfed_tpu.fl import run_fedavg_rounds
+    from rayfed_tpu.models import logistic
+
+    cluster = make_cluster(["solo"])
+    fed.init(address="local", cluster=cluster, party="solo")
+    try:
+        d, classes, n = 4, 2, 16
+        x = jax.random.normal(jax.random.PRNGKey(1), (n, d))
+        y = (x[:, 0] > 0).astype(jnp.int32)
+        step = logistic.make_train_step(logistic.apply_logistic, lr=0.3)
+
+        @fed.remote
+        class Trainer:
+            def train(self, params):
+                params, _ = step(params, x, y)
+                return params
+
+        trainers = {"solo": Trainer.party("solo").remote()}
+        params = logistic.init_logistic(jax.random.PRNGKey(0), d, classes)
+        ckpt = FedCheckpointer(str(tmp_path / "solo"), party="solo")
+        run_fedavg_rounds(trainers, params, rounds=3, checkpointer=ckpt)
+        assert ckpt.latest_round() == 3
+    finally:
+        fed.shutdown()

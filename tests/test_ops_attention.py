@@ -157,6 +157,22 @@ def test_zigzag_requires_causal_flash():
         zig(q, k, v)
 
 
+def test_wrapped_attention_rejects_window():
+    # LlamaConfig(sliding_window=...) passes window= through attn_fn;
+    # ring/Ulysses builders must reject it with a named error, not a
+    # bare unexpected-keyword TypeError.
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(11), t=32, d=16)
+    for fn, name in (
+        (make_ring_attention(mesh, "sp", causal=True), "ring"),
+        (make_ulysses_attention(mesh, "sp", causal=True), "ulysses"),
+    ):
+        with pytest.raises(ValueError, match=f"{name}.*sliding-window"):
+            fn(q, k, v, causal=True, window=8)
+        # window=None is a no-op, matching the dense signature.
+        fn(q, k, v, causal=True, window=None)
+
+
 def test_ulysses_requires_divisible_heads():
     mesh = create_mesh({"sp": 8})
     q, k, v = _qkv(jax.random.PRNGKey(4), h=4)  # 4 heads, 8-way axis
