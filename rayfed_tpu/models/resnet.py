@@ -193,6 +193,37 @@ PARTITION_RULES = (
 )
 
 
+def _make_loss_fn(config: ResNetConfig):
+    from rayfed_tpu.models.logistic import softmax_cross_entropy
+
+    def loss_fn(params, state, x, y):
+        logits, new_state = apply_resnet(params, state, x, config, train=True)
+        return softmax_cross_entropy(logits, y), new_state
+
+    return loss_fn
+
+
+def _make_sgd_step(config: ResNetConfig, lr: float, momentum: float):
+    """Shared un-jitted step body for both train-step factories — a
+    change to the loss/update rule applies to the plain and fed paths
+    alike."""
+    loss_fn = _make_loss_fn(config)
+
+    def step(params, state, opt, x, y):
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, x, y
+        )
+        new_opt = jax.tree_util.tree_map(
+            lambda m, g: momentum * m + g, opt, grads
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_opt
+        )
+        return new_params, new_state, new_opt, loss
+
+    return step
+
+
 def make_train_step(
     config: ResNetConfig,
     lr: float = 0.1,
@@ -207,26 +238,44 @@ def make_train_step(
     those buffers out from under the transport.  Donate only in
     single-owner training loops.
     """
-    from rayfed_tpu.models.logistic import softmax_cross_entropy
-
-    def loss_fn(params, state, x, y):
-        logits, new_state = apply_resnet(params, state, x, config, train=True)
-        return softmax_cross_entropy(logits, y), new_state
-
-    def step(params, state, opt, x, y):
-        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, state, x, y
-        )
-        new_opt = jax.tree_util.tree_map(
-            lambda m, g: momentum * m + g, opt, grads
-        )
-        new_params = jax.tree_util.tree_map(
-            lambda p, m: p - lr * m, params, new_opt
-        )
-        return new_params, new_state, new_opt, loss
-
+    step = _make_sgd_step(config, lr, momentum)
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
 def init_opt_state(params: Params) -> Params:
     return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def make_fed_train_step(
+    config: ResNetConfig,
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    *,
+    wire_dtype: Any = jnp.bfloat16,
+    local_steps: int = 1,
+):
+    """One FedAvg round's local work as a SINGLE jitted call.
+
+    ``(wire_bundle, x, y) -> (wire_bundle, loss)`` where ``wire_bundle``
+    is the ``(params, state)`` tree in ``wire_dtype`` exactly as it
+    crosses parties (:mod:`rayfed_tpu.fl.compression` form).  The
+    decompress (wire→f32), fresh-momentum init, ``local_steps`` SGD
+    steps, and recompress (f32→wire) all live INSIDE the jit, so XLA
+    fuses the casts into adjacent ops instead of the caller paying
+    ~2×|params| of separate elementwise passes plus per-leaf dispatch
+    per round — the difference matters when a round is seconds, not
+    minutes (BASELINE.md #3's ≥0.9-of-floor target).
+    """
+    from rayfed_tpu.fl.compression import cast_floats
+
+    step = _make_sgd_step(config, lr, momentum)
+
+    def fed_step(wire_bundle, x, y):
+        params, state = cast_floats(wire_bundle, jnp.float32)
+        opt = init_opt_state(params)
+        loss = jnp.zeros((), jnp.float32)
+        for _ in range(local_steps):
+            params, state, opt, loss = step(params, state, opt, x, y)
+        return cast_floats((params, state), wire_dtype), loss
+
+    return jax.jit(fed_step)

@@ -33,7 +33,9 @@ def run_resnet_fedavg(party, cluster=RESNET_CLUSTER):
     n, hw = 32, 8  # 8x8 images: conv stack is real, compute is tiny
 
     # Same trainer shape as bench.py::_run_resnet_party (full ResNet-18
-    # there; tiny config here) — change them together.
+    # there; tiny config here) — change them together: the fused
+    # wire-dtype round (make_fed_train_step, bf16 bundles on the wire)
+    # is exactly the program the bench measures.
     @fed.remote
     class Trainer:
         def __init__(self, seed: int):
@@ -43,19 +45,16 @@ def run_resnet_fedavg(party, cluster=RESNET_CLUSTER):
             # channel-mean pixels (same probe every party, different data).
             probe = jax.random.normal(jax.random.PRNGKey(0), (3, cfg.num_classes))
             self._y = jnp.argmax(jnp.mean(self._x, axis=(1, 2)) @ probe, axis=-1)
-            self._step = resnet.make_train_step(cfg, lr=0.05)
+            self._step = resnet.make_fed_train_step(cfg, lr=0.05, local_steps=2)
 
-        def train(self, bundle, steps=2):
-            params, state = bundle
-            opt = resnet.init_opt_state(params)
-            for _ in range(steps):
-                params, state, opt, loss = self._step(
-                    params, state, opt, self._x, self._y
-                )
-            return params, state
+        def train(self, bundle):
+            out, _loss = self._step(bundle, self._x, self._y)
+            return out
 
         def loss(self, bundle):
-            params, state = bundle
+            from rayfed_tpu.fl import decompress
+
+            params, state = decompress(bundle)
             logits, _ = resnet.apply_resnet(
                 params, state, self._x, cfg, train=False
             )
@@ -65,7 +64,9 @@ def run_resnet_fedavg(party, cluster=RESNET_CLUSTER):
 
     trainers = {p: Trainer.party(p).remote(i + 1) for i, p in enumerate(PARTIES)}
 
-    bundle = resnet.init_resnet(jax.random.PRNGKey(0), cfg)
+    from rayfed_tpu.fl import compress
+
+    bundle = compress(resnet.init_resnet(jax.random.PRNGKey(0), cfg))
     first_loss = fed.get(trainers["alice"].loss.remote(bundle))
 
     for _round in range(3):

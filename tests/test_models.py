@@ -57,6 +57,43 @@ def test_resnet18_forward_and_train_step():
     assert float(jnp.sum(jnp.abs(state["stem"]["mean"]))) > 0
 
 
+def test_resnet_fed_train_step_matches_unfused():
+    # The fused wire-dtype round (cast+opt-init+step+cast in ONE jit)
+    # must match the explicit decompress -> init_opt -> step -> compress
+    # chain it replaces in the FedAvg trainers.
+    from rayfed_tpu.fl import compress, decompress
+
+    cfg = resnet.ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=10)
+    params, state = resnet.init_resnet(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 3])
+    wire = compress((params, state))
+
+    fed_step = resnet.make_fed_train_step(cfg, lr=0.01)
+    fused_wire, fused_loss = fed_step(wire, x, y)
+
+    p2, s2 = decompress(wire)
+    step = resnet.make_train_step(cfg, lr=0.01)
+    p2, s2, _opt, loss = step(p2, s2, resnet.init_opt_state(p2), x, y)
+    expected_wire = compress((p2, s2))
+
+    assert float(fused_loss) == pytest.approx(float(loss), rel=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(fused_wire),
+        jax.tree_util.tree_leaves(expected_wire),
+    ):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            atol=1e-2, rtol=1e-2,
+        )
+
+    # local_steps > 1 runs the whole multi-step round in one call.
+    fed_step2 = resnet.make_fed_train_step(cfg, lr=0.01, local_steps=2)
+    w2, l2 = fed_step2(wire, x, y)
+    assert float(l2) != pytest.approx(float(fused_loss))
+
+
 def test_resnet_partition_rules_apply():
     mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
     cfg = resnet.ResNetConfig(stage_sizes=(1,), width=8)
