@@ -152,13 +152,27 @@ def _run_split_party(party: str, result_q) -> None:
     # GB/s, so the parties' CPU FLOPs must not be the bottleneck.
     n, d_in, d_hidden, classes, k_mb = 4096, 16, 1024, 10, 8
 
-    @fed.remote
-    def load_x(mb):
+    # ONE set of constructors for the trainer, the data loaders, AND the
+    # compute probe — the probe's ceiling only corresponds to the
+    # benchmarked step while these stay shared.
+    def make_encoder_params():
+        return {
+            "k": jax.random.normal(jax.random.PRNGKey(0), (d_in, d_hidden)) * 0.05
+        }
+
+    def make_head_params():
+        return {
+            "k": jax.random.normal(jax.random.PRNGKey(1), (d_hidden, classes)) * 0.05
+        }
+
+    def make_x(mb):
         return jax.random.normal(jax.random.PRNGKey(70 + mb), (n, d_in))
 
-    @fed.remote
-    def load_y(mb):
+    def make_y(mb):
         return jax.random.randint(jax.random.PRNGKey(80 + mb), (n,), 0, classes)
+
+    load_x = fed.remote(make_x)
+    load_y = fed.remote(make_y)
 
     def encoder_apply(params, x):
         return jax.nn.relu(x @ params["k"])
@@ -170,15 +184,9 @@ def _run_split_party(party: str, result_q) -> None:
         return SplitTrainer(
             encoder_party="alice",
             head_party="bob",
-            encoder_params={
-                "k": jax.random.normal(jax.random.PRNGKey(0), (d_in, d_hidden))
-                * 0.05
-            },
+            encoder_params=make_encoder_params(),
             encoder_apply=encoder_apply,
-            head_params={
-                "k": jax.random.normal(jax.random.PRNGKey(1), (d_hidden, classes))
-                * 0.05
-            },
+            head_params=make_head_params(),
             head_apply=head_apply,
             loss_fn=softmax_cross_entropy,
             lr=0.1,
@@ -197,26 +205,91 @@ def _run_split_party(party: str, result_q) -> None:
     xs = x_objs[:k_mb_eff]
     ys = y_objs[:k_mb_eff]
 
-    def timed(trainer):
-        trainer.step_pipelined(xs, ys)  # warmup + compile
-        # Barrier on the *encoder* queue: get_params is ordered after
-        # every backward/apply, so warmup's reverse traffic fully drains
-        # before t0 and the timed window includes the last step's
-        # reverse traffic.
-        fed.get(trainer.encoder_params())
-        total0 = metrics.get_transfer_log().total_recorded
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            trainer.step_pipelined(xs, ys)
-        fed.get(trainer.encoder_params())
-        elapsed = time.perf_counter() - t0
-        recs, complete = metrics.get_transfer_log().records_since(total0)
-        if not complete:  # ring evicted part of the window
-            return elapsed, float("nan"), float("nan")
-        wire_read_s = sum(r.seconds for r in recs if r.direction == "recv")
-        send_s = sum(r.seconds for r in recs if r.direction == "send")
-        return elapsed, wire_read_s, send_s
+    def timed(trainer, windows=3):
+        """Best-of-``windows`` timing (plus that window's decomposition).
 
+        One window at a time is not interpretable on the shared bench
+        host: r4's split section happened to run during a load spike and
+        recorded 0.056 GB/s for a path that measures ~0.3 GB/s on a
+        quiet host — a 5.7× f32-vs-bf16 'anomaly' that was entirely host
+        state (the raw transport is bytes-linear: 16.8 MB pushes at
+        ~30 ms, 8.4 MB at ~14 ms round-trip, no threshold cliff).
+        """
+        trainer.step_pipelined(xs, ys)  # warmup + compile
+        best = None
+        for _w in range(windows):
+            # Barrier on the *encoder* queue: get_params is ordered after
+            # every backward/apply, so prior traffic fully drains before
+            # t0 and the window includes the last step's reverse traffic.
+            fed.get(trainer.encoder_params())
+            total0 = metrics.get_transfer_log().total_recorded
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                trainer.step_pipelined(xs, ys)
+            fed.get(trainer.encoder_params())
+            elapsed = time.perf_counter() - t0
+            recs, complete = metrics.get_transfer_log().records_since(total0)
+            if complete:
+                read_s = sum(r.seconds for r in recs if r.direction == "recv")
+                send_s = sum(r.seconds for r in recs if r.direction == "send")
+            else:  # ring evicted part of the window
+                read_s = send_s = float("nan")
+            # Prefer complete windows: a faster ring-evicted window must
+            # not discard a complete window's decomposition (NaNs would
+            # propagate into the artifact).
+            key = (not complete, elapsed)
+            if best is None or key < best[0]:
+                best = (key, (elapsed, read_s, send_s))
+        return best[1]
+
+    # Local-compute probe: ALICE alone times BOTH halves of the step's
+    # math back-to-back (same constructors as the trainer, jitted, no
+    # transport) so the parent can print the serialized 1-core ceiling
+    # bytes/(compute_s + bytes/wire_GBps) next to the measured number.
+    # One process probing serially is the point: with both parties
+    # probing concurrently on the 1-core host, each wall-clock includes
+    # the other's compute and the summed "ceiling" would be understated
+    # (even reading as measured > ceiling).  While alice probes, bob is
+    # parked at its first recv.
+    def compute_probe_ms() -> float:
+        if party != "alice":
+            return 0.0
+        k_enc = make_encoder_params()["k"]
+        k_head = make_head_params()["k"]
+        x = make_x(0)
+        y = make_y(0)
+
+        # Encoder: forward + recompute-backward (same shape of work as
+        # _EncoderActor._fwd/_grads).
+        fwd = jax.jit(lambda p, x: encoder_apply({"k": p}, x))
+        h = fwd(k_enc, x)
+
+        def bwd(p, x, g):
+            out, vjp = jax.vjp(lambda p: encoder_apply({"k": p}, x), p)
+            return vjp(g)[0]
+
+        bwd = jax.jit(bwd)
+        g = jnp.ones_like(h)
+
+        # Head: loss + grads wrt head params and activations (same shape
+        # of work as _HeadActor._grads).
+        def f(p, h):
+            return softmax_cross_entropy(head_apply({"k": p}, h), y)
+
+        head_grads = jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+
+        def one_step():
+            jax.block_until_ready(
+                (fwd(k_enc, x), bwd(k_enc, x, g), head_grads(k_head, h))
+            )
+
+        one_step()  # compile
+        t0 = time.perf_counter()
+        for _ in range(4):
+            one_step()
+        return (time.perf_counter() - t0) / 4 * 1e3
+
+    probe_ms = compute_probe_ms() * k_mb_eff
     el_f32, read_f32, send_f32 = timed(make_trainer(None))
     el_bf16, _read, _send = timed(make_trainer(jnp.bfloat16))
 
@@ -236,6 +309,7 @@ def _run_split_party(party: str, result_q) -> None:
                     "other_ms": max(el_f32 - read_f32 - send_f32, 0.0)
                     / steps
                     * 1e3,
+                    "compute_probe_ms": probe_ms,
                 },
             )
         )
@@ -1458,6 +1532,7 @@ def main() -> None:
         extra["split_fl_wire_read_ms"] = round(alice["wire_read_ms"], 2)
         extra["split_fl_send_path_ms"] = round(alice["send_path_ms"], 2)
         extra["split_fl_other_ms"] = round(alice["other_ms"], 2)
+        split_compute_s = sum(v["compute_probe_ms"] for v in sres.values()) / 1e3
         _log(
             f"  split: {gbps:.3f} GB/s; per-step wire-read "
             f"{alice['wire_read_ms']:.1f} ms, send-path "
@@ -1468,11 +1543,48 @@ def main() -> None:
         )
         _settle()
 
+        # Push bench AFTER the split section (lightest-first: its 128MB
+        # floods would deflate a subsequent split window ~4x via socket
+        # drain + page-cache churn) — the split ceiling is derived below
+        # once both numbers exist.
         _log("raw send-proxy push throughput (128MB sharded, loopback)...")
         push, reshard = _one_child("_run_push_bench")
         extra["push_GBps"] = round(push, 3)
         extra["push_reshard_GBps"] = round(reshard, 3)
         _log(f"  push: {push:.3f} GB/s wire, {reshard:.3f} GB/s with re-shard")
+
+        # Serialized 1-core model for the split step: every byte crosses
+        # the wire once and every FLOP runs once, all on one core —
+        # predicted steps/s = 1/(compute_s + bytes/wire_GBps).  Both
+        # terms measured (alice's serial local-compute probe of both
+        # halves + the push bench's wire GB/s), but each under slightly
+        # different conditions (the push bench moves 128MB sharded
+        # arrays; the split moves 16.8MB ones with cheaper per-byte
+        # cost), so the model is a sanity reference, good to ~±15%: a
+        # measured number far BELOW it flags a real pathology (r4's
+        # 0.056 GB/s would have read ~0.1 of model), slightly above it
+        # just means the wire term was conservative.
+        step_bytes = (
+            extra["split_fl_GBps"] * 1e9 / extra["split_fl_steps_per_sec"]
+            if extra["split_fl_steps_per_sec"]
+            else 0.0
+        )
+        if push > 0 and (split_compute_s > 0 or step_bytes > 0):
+            wire_s = step_bytes / (push * 1e9)
+            ceiling_sps = 1.0 / (split_compute_s + wire_s)
+            extra["split_fl_ceiling_steps_per_sec"] = round(ceiling_sps, 3)
+            extra["split_fl_vs_ceiling"] = round(
+                extra["split_fl_steps_per_sec"] / ceiling_sps, 3
+            )
+            _log(
+                f"  split serialized model: {ceiling_sps:.2f} steps/s "
+                f"(compute {split_compute_s*1e3:.0f} ms + wire "
+                f"{wire_s*1e3:.0f} ms) -> measured f32 is "
+                f"{extra['split_fl_vs_ceiling']} of it"
+            )
+        else:
+            extra["split_fl_ceiling_steps_per_sec"] = None
+            extra["split_fl_vs_ceiling"] = None
         _settle()
 
         _log("2-party Llama-LoRA federated fine-tune (CPU parties)...")
