@@ -56,6 +56,9 @@ def init(
     cross_silo_timeout_in_seconds: float = 60,
     recv_backstop_in_seconds: Optional[float] = None,
     mailbox_ttl_in_seconds: Optional[float] = None,
+    peer_failfast: bool = True,
+    peer_health_interval_in_seconds: Optional[float] = None,
+    peer_death_pings: Optional[int] = None,
     enable_waiting_for_other_parties_ready: bool = False,
     global_metadata: Optional[Dict] = None,
     grpc_metadata: Optional[Dict] = None,  # reference-compat alias
@@ -85,6 +88,11 @@ def init(
       locally visible devices (see :mod:`rayfed_tpu.parallel.mesh`);
     - ``device_put_received``: place received array payloads onto local
       devices eagerly;
+    - ``peer_failfast`` (+ ``peer_health_interval_in_seconds``,
+      ``peer_death_pings``): while recvs are parked on a party, ping its
+      transport; after N consecutive failures the parked ``fed.get``
+      raises :class:`~rayfed_tpu.exceptions.RemoteError` naming the dead
+      party instead of waiting out the recv backstop;
     - ``process_default``: also register this runtime as the process-wide
       default (disable when simulating multiple parties in one process);
     - ``coordinator_address`` + ``num_party_processes`` +
@@ -135,6 +143,11 @@ def init(
         job_config.recv_backstop_s = float(recv_backstop_in_seconds)
     if mailbox_ttl_in_seconds is not None:
         job_config.mailbox_ttl_s = float(mailbox_ttl_in_seconds)
+    job_config.peer_failfast = bool(peer_failfast)
+    if peer_health_interval_in_seconds is not None:
+        job_config.peer_health_interval_s = float(peer_health_interval_in_seconds)
+    if peer_death_pings is not None:
+        job_config.peer_death_pings = int(peer_death_pings)
 
     party_group = None
     if coordinator_address is not None:

@@ -121,3 +121,13 @@ class JobConfig:
     # desynced/dead peers without gating slow-but-healthy ones.
     recv_backstop_s: float = 3600.0
     mailbox_ttl_s: float = 3600.0
+    # Peer-death fail-fast: while recvs are parked on a party, ping it
+    # every peer_health_interval_s; after peer_death_pings consecutive
+    # failures the pending recvs raise RemoteError naming the party
+    # instead of parking until the backstop.  Pings probe the peer's
+    # transport loop, not its task queue — slow compute can't trip this,
+    # and a party only becomes eligible after it was reachable once
+    # (startup skew parks, it doesn't kill).
+    peer_failfast: bool = True
+    peer_health_interval_s: float = 2.0
+    peer_death_pings: int = 3

@@ -49,6 +49,11 @@ class TransportManager:
 
         self._mailbox = Mailbox(ttl_s=job_config.mailbox_ttl_s)
         self._gc_task: Optional[asyncio.TimerHandle] = None
+        self._health_task: Optional[asyncio.Task] = None
+        # Parties whose server acked one of our sends — reachability
+        # evidence for the health monitor (set.add is atomic; read on
+        # the loop thread, written from send callbacks).
+        self._peers_acked: set = set()
         my_cfg = cluster_config.party_config(self._party)
         listen_addr = my_cfg.listen_addr or my_cfg.address
         self._server = TransportServer(
@@ -104,6 +109,97 @@ class TransportManager:
         self._gc_task = self._loop.call_soon_threadsafe(
             lambda: self._loop.call_later(30.0, _periodic_gc)
         )
+        if self._job.peer_failfast:
+            self._loop.call_soon_threadsafe(
+                lambda: setattr(
+                    self, "_health_task",
+                    self._loop.create_task(self._health_monitor()),
+                )
+            )
+
+    async def _health_monitor(self) -> None:
+        """Peer-death fail-fast: ping parties that parked recvs are
+        waiting on; after ``peer_death_pings`` consecutive failures, fail
+        those recvs with a ``RemoteError`` naming the party instead of
+        letting them park until the recv backstop (improves on reference
+        ``barriers.py:244-248``, which leaves the consumer blind).  A
+        declared-dead party keeps being pinged and is un-poisoned the
+        moment it answers again.
+
+        A ping only fails when the peer's transport cannot answer a
+        1-RTT control frame within the interval — its event loop serves
+        pings independently of task compute, so a slow-but-healthy party
+        does not trip this (the generous recv backstop stays the only
+        limit on compute time).
+        """
+        from rayfed_tpu.exceptions import RemoteError
+
+        interval = self._job.peer_health_interval_s
+        threshold = max(1, int(self._job.peer_death_pings))
+        fails: Dict[str, int] = {}
+        # Fail-fast covers connection LOSS, not never-connected: a party
+        # only becomes eligible after evidence of reachability — a
+        # successful health ping, a delivered message (mailbox), or an
+        # acked send (self._peers_acked).  Cross-silo parties routinely
+        # start minutes apart, and a not-up-yet peer must park recvs
+        # (bounded by the backstop), not get declared dead.
+        ever_reachable: set = set()
+
+        async def probe(party: str) -> bool:
+            try:
+                return await asyncio.wait_for(
+                    self._get_client(party).ping(timeout_s=min(1.0, interval)),
+                    timeout=interval,
+                )
+            except Exception:
+                return False
+
+        while True:
+            await asyncio.sleep(interval)
+            parties = sorted(
+                self._mailbox.parties_with_waiters()
+                | self._mailbox.dead_parties()
+            )
+            # Consecutive means consecutive: a party that left the
+            # monitored set (its recvs resolved) starts from zero next
+            # time it parks — stale counts from old blips must not
+            # combine with a fresh transient into a false death.
+            fails = {p: c for p, c in fails.items() if p in parties}
+            ever_reachable |= self._mailbox.seen_parties()
+            ever_reachable |= self._peers_acked
+            # Concurrent probes: one unreachable party must not delay
+            # (and thereby slow detection for) the others.
+            results = await asyncio.gather(*(probe(p) for p in parties))
+            for party, ok in zip(parties, results):
+                if ok:
+                    ever_reachable.add(party)
+                    fails.pop(party, None)
+                    if party in self._mailbox.dead_parties():
+                        logger.info(
+                            "[%s] party %s reachable again; clearing "
+                            "fail-fast poison", self._party, party,
+                        )
+                        self._mailbox.clear_party_failure(party)
+                elif (
+                    party in ever_reachable
+                    and party not in self._mailbox.dead_parties()
+                ):
+                    fails[party] = fails.get(party, 0) + 1
+                    if fails[party] >= threshold:
+                        logger.warning(
+                            "[%s] party %s unreachable (%d consecutive "
+                            "pings); failing its pending recvs",
+                            self._party, party, fails[party],
+                        )
+                        err = RemoteError(
+                            party,
+                            "ConnectionError",
+                            f"party {party!r} is unreachable "
+                            f"({fails[party]} consecutive health pings "
+                            f"failed over ~{fails[party] * interval:.0f}s); "
+                            f"its pending sends will never arrive",
+                        ).to_wire()
+                        self._mailbox.fail_party(party, err)
 
     def stop(self) -> None:
         async def _shutdown():
@@ -279,6 +375,7 @@ class TransportManager:
                 def _done(f):
                     try:
                         f.result()
+                        self._peers_acked.add(dest_party)
                         dt = time.perf_counter() - t0
                         self.stats["send_bytes"] += nbytes
                         self.stats["send_seconds"] += dt
@@ -352,6 +449,9 @@ class TransportManager:
                 # Backstop deadline: an abandoned recv surfaces as an
                 # error instead of a parked coroutine leaking forever.
                 timeout_s=self._job.recv_backstop_s,
+                # Lets the health monitor fail exactly this waiter when
+                # src_party dies (peer-death fail-fast).
+                src_party=src_party,
             ),
             self._loop,
         )

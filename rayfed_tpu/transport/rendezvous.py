@@ -19,6 +19,14 @@ Hardening beyond the reference:
 - **Recv deadline**: ``get(..., timeout_s=...)`` raises ``TimeoutError``
   instead of parking forever, so a dead peer surfaces as an error on
   ``fed.get`` rather than a hang.
+- **Peer-death fail-fast**: :meth:`Mailbox.fail_party` poisons every
+  parked waiter expecting a party (and, until
+  :meth:`Mailbox.clear_party_failure`, any new waiter on it) with an
+  error message, so the transport's health monitor can turn "connection
+  lost / peer unreachable" into a prompt ``RemoteError`` on ``fed.get``
+  instead of a park until the recv backstop.  The reference is blind
+  here (``barriers.py:244-248`` swallows send failures into False and
+  its consumer never learns).
 """
 
 from __future__ import annotations
@@ -52,12 +60,16 @@ class Message:
 
 
 class _Entry:
-    __slots__ = ("event", "message", "created_at")
+    __slots__ = ("event", "message", "created_at", "expected_src")
 
     def __init__(self) -> None:
         self.event = asyncio.Event()
         self.message: Optional[Message] = None
         self.created_at = time.monotonic()
+        # The party a parked waiter expects data from (None until a recv
+        # declares it) — lets fail_party target exactly the waiters a
+        # dead peer owes.
+        self.expected_src: Optional[str] = None
 
 
 class Mailbox:
@@ -72,12 +84,21 @@ class Mailbox:
             collections.OrderedDict()
         )
         self._ttl_s = ttl_s
+        # party -> wire-form error dict; recvs expecting these parties
+        # fail immediately until clear_party_failure.
+        self._dead_parties: Dict[str, Dict[str, str]] = {}
+        # Every party that ever delivered data here — evidence of
+        # reachability for the health monitor's loss-not-absence gate.
+        self._seen_parties: set = set()
         self.stats: Dict[str, int] = {
             "dropped_duplicates": 0,
             "expired": 0,
+            "peer_failed_recvs": 0,
         }
 
     def put(self, message: Message) -> None:
+        if message.error is None:
+            self._seen_parties.add(message.src_party)
         key = (message.upstream_seq_id, message.downstream_seq_id)
         if key in self._consumed:
             # Re-delivery of an already-consumed rendezvous (sender retry
@@ -103,12 +124,29 @@ class Mailbox:
         upstream_seq_id: str,
         downstream_seq_id: str,
         timeout_s: Optional[float] = None,
+        src_party: Optional[str] = None,
     ) -> Message:
         key = (str(upstream_seq_id), str(downstream_seq_id))
         entry = self._entries.get(key)
         if entry is None:
             entry = _Entry()
             self._entries[key] = entry
+        if src_party is not None:
+            entry.expected_src = src_party
+        # A party already declared dead fails this recv immediately —
+        # unless its data actually raced in first (prefer real data).
+        if (
+            entry.message is None
+            and src_party is not None
+            and src_party in self._dead_parties
+        ):
+            self.stats["peer_failed_recvs"] += 1
+            self._entries.pop(key, None)
+            self._mark_consumed(key)
+            return Message(
+                src_party, key[0], key[1], b"", {},
+                error=dict(self._dead_parties[src_party]),
+            )
         try:
             if timeout_s is None:
                 await entry.event.wait()
@@ -127,6 +165,46 @@ class Mailbox:
         self._mark_consumed(key)
         assert entry.message is not None
         return entry.message
+
+    def fail_party(
+        self, party: str, error: Dict[str, str], poison_new: bool = True
+    ) -> int:
+        """Fail every parked waiter expecting ``party`` with ``error``
+        (wire-form dict, see ``RemoteError.to_wire``); with
+        ``poison_new`` (default), new recvs on it fail immediately until
+        :meth:`clear_party_failure`.  Returns the number of waiters
+        failed.  Loop-thread only, like every Mailbox method."""
+        failed = 0
+        for key, entry in list(self._entries.items()):
+            if entry.message is None and entry.expected_src == party:
+                entry.message = Message(
+                    party, key[0], key[1], b"", {}, error=dict(error)
+                )
+                entry.event.set()
+                failed += 1
+        self.stats["peer_failed_recvs"] += failed
+        if poison_new:
+            self._dead_parties[party] = dict(error)
+        return failed
+
+    def clear_party_failure(self, party: str) -> None:
+        """The party is reachable again: stop failing new recvs on it."""
+        self._dead_parties.pop(party, None)
+
+    def dead_parties(self):
+        return set(self._dead_parties)
+
+    def seen_parties(self):
+        """Parties that have delivered data to this mailbox."""
+        return set(self._seen_parties)
+
+    def parties_with_waiters(self):
+        """Parties that parked waiters currently expect data from."""
+        return {
+            e.expected_src
+            for e in self._entries.values()
+            if e.message is None and e.expected_src is not None
+        }
 
     def gc(self, now: Optional[float] = None) -> int:
         """Expire undelivered messages older than the TTL; returns count."""

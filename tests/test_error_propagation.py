@@ -163,3 +163,143 @@ def run_actor_method_raises(party, cluster):
 
 def test_actor_failure_surfaces_on_get():
     run_parties(run_actor_method_raises, ["alice", "bob"], args=(CLUSTER_AB,))
+
+
+# --- peer death: a crashed party fails its peers' recvs promptly -----------
+
+PEER_DEATH_CLUSTER = make_cluster(["alice", "bob"])
+
+
+def run_peer_death(party, cluster):
+    import os
+
+    import rayfed_tpu as fed
+
+    fed.init(
+        address="local",
+        cluster=cluster,
+        party=party,
+        recv_backstop_in_seconds=300,
+        peer_health_interval_in_seconds=0.5,
+        peer_death_pings=2,
+    )
+
+    @fed.remote
+    def produce():
+        # Never runs to completion on bob: the process dies first.
+        time.sleep(60)
+        return 1
+
+    obj = produce.party("bob").remote()
+
+    if party == "bob":
+        # Crash hard mid-round: no shutdown, no poison push, no TCP FIN
+        # courtesy beyond what the kernel sends for a dying process.
+        # Long enough for alice's monitor to have pinged bob successfully
+        # at least once first (fail-fast only covers connection LOSS).
+        time.sleep(3.0)
+        os._exit(17)
+
+    # alice: the parked get must fail via the health monitor in a few
+    # ping intervals — promptly, naming bob — NOT at the 300s backstop.
+    t0 = time.monotonic()
+    try:
+        fed.get(obj)
+        raise AssertionError("expected RemoteError for dead peer")
+    except fed.RemoteError as e:
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30, f"fail-fast took {elapsed:.1f}s"
+        assert e.party == "bob"
+        assert "unreachable" in str(e)
+    # New recvs on the dead party fail immediately (poisoned window).
+    t0 = time.monotonic()
+    obj2 = produce.party("bob").remote()
+    try:
+        fed.get(obj2)
+        raise AssertionError("expected RemoteError for poisoned peer")
+    except fed.RemoteError as e:
+        assert time.monotonic() - t0 < 10
+        assert e.party == "bob"
+    fed.shutdown()
+
+
+# --- pipelined rounds: poison propagates through the lazy chain ------------
+
+PIPELINE_FAIL_CLUSTER = make_cluster(["alice", "bob", "carol"])
+
+
+def run_pipelined_round_failure(party, cluster):
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import aggregate
+
+    fed.init(
+        address="local",
+        cluster=cluster,
+        party=party,
+        recv_backstop_in_seconds=300,
+    )
+    parties = ("alice", "bob", "carol")
+
+    @fed.remote
+    class Trainer:
+        def __init__(self):
+            self._round = 0
+
+        def train(self, x):
+            self._round += 1
+            # bob's task raises at ITS round 2 — mid-chain, after the
+            # lazy DAG for later rounds is already issued.
+            if self._round == 2 and party_name == "bob":
+                raise ValueError("round-2-boom")
+            return x + 1.0
+
+    # The actor runs on its own party; bake the owner's name in so the
+    # raise happens on bob's executor only.
+    party_name = party
+
+    trainers = {p: Trainer.party(p).remote() for p in parties}
+
+    # 4 pipelined rounds, coordinator mode (alice owns the averages):
+    # round 2's failure on bob must poison round 2's average, whose
+    # poison must flow through rounds 3 and 4 as failed args and reach
+    # every party's final get — promptly, not at the 300s backstop.
+    obj = 0.0
+    for _ in range(4):
+        updates = [trainers[p].train.remote(obj) for p in parties]
+        obj = aggregate(updates, mode="coordinator", materialize=False)
+
+    t0 = time.monotonic()
+    try:
+        fed.get(obj)
+        raise AssertionError("expected RemoteError from the lazy chain")
+    except fed.RemoteError as e:
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60, f"poison took {elapsed:.1f}s to propagate"
+        # The poison chain: bob's ValueError fails alice's _avg (failed
+        # arg), whose re-poison carries the party that failed — so the
+        # surfaced error names bob (root cause) or alice (the
+        # coordinator whose average task it sank), and bob's original
+        # message rides the nested detail when the root cause surfaces.
+        assert e.party in ("alice", "bob"), e.party
+        if e.party == "bob":
+            assert "round-2-boom" in str(e)
+    fed.shutdown()
+
+
+def test_pipelined_round_failure_propagates():
+    run_parties(
+        run_pipelined_round_failure,
+        ["alice", "bob", "carol"],
+        args=(PIPELINE_FAIL_CLUSTER,),
+        timeout=150,
+    )
+
+
+def test_peer_death_fails_pending_recvs_fast():
+    run_parties(
+        run_peer_death,
+        ["alice", "bob"],
+        args=(PEER_DEATH_CLUSTER,),
+        expect_exitcodes={"bob": 17},
+        timeout=120,
+    )
