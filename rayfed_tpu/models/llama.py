@@ -269,10 +269,12 @@ def _linear(x, w, lora_entry, dtype):
     """x @ w with an optional LoRA low-rank bypass (x@A)@B · scale.
 
     ``w`` may be an int8 :class:`~rayfed_tpu.models.quant.QTensor` (frozen
-    base in a LoRA fine-tune); its dequant fuses into the matmul."""
-    from rayfed_tpu.models.quant import as_weight
+    base in a LoRA fine-tune); quant.matmul keeps the weight-side dequant
+    a pure fusable convert (scale applied to the output) so decode reads
+    int8 bytes from HBM, not a materialized bf16 copy."""
+    from rayfed_tpu.models.quant import matmul
 
-    out = x @ as_weight(w, dtype)
+    out = matmul(x, w, dtype)
     if lora_entry is not None:
         a = lora_entry["a"].astype(dtype)
         b = lora_entry["b"].astype(dtype)
@@ -345,21 +347,27 @@ def _lm_head(x, params, config):
     at a fraction of bf16 throughput and the f32 accumulator already
     carries the precision the loss needs.
     """
-    from rayfed_tpu.models.quant import as_weight
+    from rayfed_tpu.models.quant import split_output_scale
 
     x = _rms_norm(x, params["final_norm"], config.rms_eps)
     head = params.get("lm_head")
-    head = (
-        params["embed"].astype(config.dtype).T
-        if head is None
-        else as_weight(head, config.dtype)
-    )
-    return jax.lax.dot_general(
+    out_scale = None
+    if head is None:
+        head = params["embed"].astype(config.dtype).T
+    else:
+        # Output-side scale keeps the weight feed a pure int8->bf16
+        # convert (see quant.split_output_scale) — the lm_head is the
+        # single largest weight read of a decode step.
+        head, out_scale = split_output_scale(head, config.dtype)
+    logits = jax.lax.dot_general(
         x.astype(config.dtype),
         head,
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if out_scale is not None:
+        logits = logits * out_scale.astype(logits.dtype)
+    return logits
 
 
 def apply_llama(

@@ -87,6 +87,48 @@ def as_weight(w: Any, dtype) -> jax.Array:
     return w.astype(dtype)
 
 
+def _scale_is_per_last_axis(scale: jax.Array) -> bool:
+    return all(d == 1 for d in scale.shape[:-1])
+
+
+def split_output_scale(w: Any, dtype):
+    """``(operand, out_scale)`` for a matmul contracting ``w``'s leading axes.
+
+    For a :class:`QTensor` whose scale is constant along every contracted
+    axis (per-OUTPUT-channel: size-1 everywhere but the last axis), the
+    dequantization commutes with the contraction — return the raw int8
+    weight as a pure-convert operand plus the [D_out] scale to apply to
+    the matmul OUTPUT.  Callers that build their own dot (e.g. a
+    ``preferred_element_type`` lm_head) share this invariant instead of
+    re-deriving it.  Anything else returns ``(dense weight, None)``.
+    """
+    if isinstance(w, QTensor) and _scale_is_per_last_axis(w.scale):
+        return w.q.astype(dtype), w.scale.reshape(w.scale.shape[-1])
+    return as_weight(w, dtype), None
+
+
+def matmul(x: jax.Array, w: Any, dtype) -> jax.Array:
+    """``x @ w`` with the int8 path arranged for memory-bound decode.
+
+    For a per-output-channel :class:`QTensor` the scale moves to the
+    OUTPUT: ``(x @ q.astype(dtype)) * scale`` — algebraically identical
+    to ``x @ (q * scale)`` (the scale is constant along the contracted
+    axis), but the weight-side op becomes a *pure convert* that XLA
+    fuses into the dot's operand feed instead of a convert+broadcast-
+    multiply it tends to materialize as a full dequantized copy in HBM.
+    At decode (GEMV, bandwidth-bound on weight reads) that
+    materialization costs ~2.5 bytes/param of traffic where the int8
+    read should cost 1 — the difference between int8 decode running at
+    int8 bandwidth and running *slower* than bf16.  Other scale layouts
+    fall back to explicit dequantization.
+    """
+    operand, out_scale = split_output_scale(w, dtype)
+    out = x @ operand
+    if out_scale is not None:
+        out = out * out_scale.astype(dtype)
+    return out
+
+
 def is_quantized(w: Any) -> bool:
     return isinstance(w, QTensor)
 

@@ -363,6 +363,36 @@ def test_llama_rolling_cache_matches_linear(kv_quant):
         tok = jnp.argmax(logits_lin, axis=-1).astype(ids.dtype)
 
 
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_llama_rolling_cache_deep_wraparound(kv_quant):
+    """pos ≫ window: 100 decoded tokens over a 16-slot ring (6+ full
+    overwrite cycles) match the linear sliding-window decode at EVERY
+    step, on bf16 and int8 KV alike — ring-buffer index bugs live at
+    large pos where (pos − i) mod W has cycled many times, not at the
+    first wrap."""
+    cfg = llama.llama_tiny(sliding_window=16, kv_quant=kv_quant)
+    params = llama.init_llama(jax.random.PRNGKey(0), cfg)
+    t0, n_new = 7, 100
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, t0), 0, cfg.vocab_size)
+    max_len = t0 + n_new
+
+    cache_lin, logits_lin = llama.prefill(params, cfg, ids, max_len)
+    step_lin = llama.make_decode_step(cfg)
+    cache_roll = llama.roll_kv_cache(cache_lin, cfg, t0)
+    assert cache_roll["k"].shape[2] == 16  # O(W), independent of n_new
+    step_roll = llama.make_decode_step(cfg, rolling=True)
+
+    tok = jnp.argmax(logits_lin, axis=-1).astype(ids.dtype)
+    for i in range(n_new):
+        cache_lin, l_lin = step_lin(params, cache_lin, tok, t0 + i)
+        cache_roll, l_roll = step_roll(params, cache_roll, tok, t0 + i)
+        np.testing.assert_allclose(
+            np.asarray(l_roll), np.asarray(l_lin), rtol=2e-4, atol=2e-4,
+            err_msg=f"diverged at decode step {i} (pos {t0 + i})",
+        )
+        tok = jnp.argmax(l_lin, axis=-1).astype(ids.dtype)
+
+
 def test_llama_rolling_cache_short_prompt():
     """t0 < W: unwritten ring slots must be masked, not attended."""
     cfg = llama.llama_tiny(sliding_window=8)

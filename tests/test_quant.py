@@ -181,3 +181,32 @@ def test_merge_lora_rejects_quantized_base():
     )
     with pytest.raises(TypeError, match="quantized"):
         lora.merge_lora(base, adapters)
+
+
+def test_quant_matmul_output_scale_equivalence():
+    # quant.matmul moves the per-output-channel scale to the output;
+    # it must match the explicit dequantize-then-matmul form exactly
+    # (same algebra, f32 reference) and fall back for non-last-axis
+    # scales.
+    from rayfed_tpu.models.quant import matmul, quantize_int8
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+    qt = quantize_int8(w)
+    ref = x @ qt.dequantize(jnp.float32)
+    out = matmul(x, qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # Per-row (contracted-axis) scale: output-scaling is invalid there,
+    # the fallback must produce the dequantized result.
+    qt_row = quantize_int8(w, channel_axis=0)
+    ref_row = x @ qt_row.dequantize(jnp.float32)
+    out_row = matmul(x, qt_row, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out_row), np.asarray(ref_row), rtol=1e-5, atol=1e-5
+    )
+
+    # Plain (unquantized) weights pass through.
+    np.testing.assert_allclose(
+        np.asarray(matmul(x, w, jnp.float32)), np.asarray(x @ w), rtol=1e-6
+    )
