@@ -103,7 +103,10 @@ def split_output_scale(w: Any, dtype):
     re-deriving it.  Anything else returns ``(dense weight, None)``.
     """
     if isinstance(w, QTensor) and _scale_is_per_last_axis(w.scale):
-        return w.q.astype(dtype), w.scale.reshape(w.scale.shape[-1])
+        # reshape(-1): also covers a 0-d per-tensor scale (QTensor's
+        # contract only demands broadcastability), which becomes a
+        # shape-(1,) output scale.
+        return w.q.astype(dtype), w.scale.reshape(-1)
     return as_weight(w, dtype), None
 
 

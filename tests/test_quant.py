@@ -210,3 +210,24 @@ def test_quant_matmul_output_scale_equivalence():
     np.testing.assert_allclose(
         np.asarray(matmul(x, w, jnp.float32)), np.asarray(x @ w), rtol=1e-6
     )
+
+
+def test_quant_matmul_scalar_scale():
+    # QTensor's contract allows any broadcastable scale, including a 0-d
+    # per-tensor one; split_output_scale must handle it (shape-(1,)
+    # output scale), matching explicit dequantization.
+    from rayfed_tpu.models.quant import matmul, split_output_scale
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 8), jnp.float32)
+    qt = QTensor(
+        q=jnp.clip(jnp.round(w / 0.01), -127, 127).astype(jnp.int8),
+        scale=jnp.asarray(0.01, jnp.float32),
+    )
+    operand, out_scale = split_output_scale(qt, jnp.float32)
+    assert out_scale.shape == (1,)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(matmul(x, qt, jnp.float32)),
+        np.asarray(x @ qt.dequantize(jnp.float32)),
+        rtol=1e-5, atol=1e-5,
+    )
