@@ -1829,6 +1829,16 @@ def main() -> None:
         coord = res.get("alice", next(iter(res.values())))
         extra["resnet_coord_wire_read_ms"] = round(coord[2], 2)
         extra["resnet_coord_send_path_ms"] = round(coord[3], 2)
+        # cross_party_GBps above divides bundle bytes by the WHOLE round
+        # (≥95% compute) — it is goodput, not wire speed.  The wire-
+        # session rate divides the coordinator's bytes by its actual
+        # read+send session time.
+        coord_bytes_per_round = coord[1] * 1e9 * coord[6]
+        wire_session_s = (coord[2] + coord[3]) / 1e3
+        if wire_session_s > 0:
+            extra["cross_party_wire_GBps"] = round(
+                coord_bytes_per_round / wire_session_s / 1e9, 3
+            )
         # Full decomposition: step wall (jitted local round incl. fused
         # wire casts), per-party CPU, and idle share.  step/wall ≈ 96%
         # on the 1-core host — the rest is transport CPU + idle.

@@ -25,6 +25,12 @@ def test_split_fl_bert_example():
     run_parties(run_split_example, ["alice", "bob"], args=(2,), timeout=240)
 
 
+def test_mesh_fedavg_example():
+    from examples.mesh_fedavg import run as run_mesh_example
+
+    run_parties(run_mesh_example, ["alice", "bob"], args=(2,), timeout=240)
+
+
 def test_serve_llama_example():
     from examples.serve_llama import run as run_serve_example
 
