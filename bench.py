@@ -1688,32 +1688,14 @@ def main() -> None:
     except OSError:  # pragma: no cover
         extra["env_loadavg_1m"] = None
     extra["env_platform"] = _platform.machine()
-    # Device kind only when the compute benches initialize the backend
-    # anyway: --fed-only must not force accelerator init in the parent
-    # (the TPU can sit behind a tunnel that is down while the CPU-only
-    # federated configs still run fine).
-    extra["env_device_kind"] = (
-        "uninitialized (--fed-only)" if fed_only else jax.devices()[0].device_kind
-    )
-
-    if not fed_only:
-        _log(f"compute benches on {jax.devices()[0].device_kind}...")
-        extra.update(bench_llama())
-        _log(f"  llama: {extra}")
-        extra.update(bench_decode())
-        _log(f"  decode: {extra}")
-        extra.update(bench_flash())
-        _log(f"  flash: {extra}")
-        try:
-            extra.update(bench_lora_8b())
-            _log(f"  lora-8b: {extra}")
-        except Exception as e:  # pragma: no cover - 16GB-chip dependent
-            # The 8B config needs ~11 GB of HBM; smaller devices (or the
-            # CPU fallback in CI) record the failure instead of dying.
-            _log(f"  lora-8b skipped: {e!r}")
-            extra["lora_8b_error"] = repr(e)[:200]
-        extra.update(bench_moe())
-        _log(f"  moe: {extra}")
+    # Device kind is recorded when the compute section initializes the
+    # backend (below).  Deliberately NOT before: touching jax.devices()
+    # here would start the accelerator tunnel, whose daemon's background
+    # CPU use on the 1-core bench host measurably degrades every
+    # CPU-bound section that follows (~15-25% on the pp/split benches —
+    # r4's "wire regression" was exactly this).  The CPU sections
+    # therefore run FIRST, accelerator init last.
+    extra["env_device_kind"] = "uninitialized (--fed-only)"
 
     if not compute_only:
         _log("1F1B + interleaved pipeline vs DP train step (4-device virtual mesh)...")
@@ -1907,7 +1889,36 @@ def main() -> None:
             "unit": "rounds/s",
             "vs_baseline": round(rps / prior, 3) if prior else 1.0,
         }
-    else:
+    if not fed_only:
+        try:
+            extra["env_device_kind"] = jax.devices()[0].device_kind
+        except Exception as e:
+            # Tunnel down: keep the fed metrics already measured (the
+            # compute section runs LAST precisely so a dead accelerator
+            # can't cost the CPU sections), record the failure, skip.
+            _log(f"  accelerator init failed; skipping compute benches: {e!r}")
+            extra["compute_bench_error"] = repr(e)[:200]
+            fed_only = True
+    if not fed_only:
+        _log(f"compute benches on {extra['env_device_kind']}...")
+        extra.update(bench_llama())
+        _log(f"  llama: {extra}")
+        extra.update(bench_decode())
+        _log(f"  decode: {extra}")
+        extra.update(bench_flash())
+        _log(f"  flash: {extra}")
+        try:
+            extra.update(bench_lora_8b())
+            _log(f"  lora-8b: {extra}")
+        except Exception as e:  # pragma: no cover - 16GB-chip dependent
+            # The 8B config needs ~11 GB of HBM; smaller devices (or the
+            # CPU fallback in CI) record the failure instead of dying.
+            _log(f"  lora-8b skipped: {e!r}")
+            extra["lora_8b_error"] = repr(e)[:200]
+        extra.update(bench_moe())
+        _log(f"  moe: {extra}")
+
+    if compute_only:
         record = {
             "metric": "llama_tokens_per_sec",
             "value": extra.get("llama_tokens_per_sec", 0.0),
