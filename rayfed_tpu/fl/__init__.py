@@ -14,6 +14,8 @@ Here the common algorithms ship with the framework:
 - :mod:`secure` — pairwise-masked secure aggregation (sum-only reveal).
 - :mod:`dp` — differential privacy: global-norm clipping + Gaussian
   noise on outgoing updates.
+- :mod:`robust` — Byzantine-robust aggregation (coordinate median,
+  trimmed mean, Krum/multi-Krum) bounding any single party's influence.
 """
 
 from rayfed_tpu.fl.compression import compress, decompress
@@ -24,6 +26,12 @@ from rayfed_tpu.fl.fedopt import (
     server_adam,
     server_sgd,
     server_yogi,
+)
+from rayfed_tpu.fl.robust import (
+    krum,
+    multi_krum,
+    tree_median,
+    tree_trimmed_mean,
 )
 from rayfed_tpu.fl.secure import mask_update, unmask_sum
 from rayfed_tpu.fl.split import SplitTrainer
@@ -45,4 +53,8 @@ __all__ = [
     "privatize",
     "clip_by_global_norm",
     "run_fedavg_rounds",
+    "tree_median",
+    "tree_trimmed_mean",
+    "krum",
+    "multi_krum",
 ]
