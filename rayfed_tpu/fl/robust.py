@@ -28,6 +28,13 @@ the choice of estimator must be part of the shared program)::
 
     values = fed.get(update_objs)           # all-to-all fetch
     agg = fl.tree_trimmed_mean(values, trim=1)
+
+Heterogeneous fleets: the *selection* rules (Krum) compare floating
+scores; although the distance matmul runs at HIGHEST precision, exact
+cross-backend bit-identity is not guaranteed, and a flipped near-tie
+returns a different whole tree per controller.  Run selection
+coordinator-side there — ``run_fedavg_rounds(aggregator=...)`` already
+does (one party reduces, the result broadcasts).
 """
 
 from __future__ import annotations
@@ -57,13 +64,24 @@ def _median_tree(stacked: Any) -> Any:
     )
 
 
-def tree_median(trees: Sequence[Any]) -> Any:
-    """Coordinate-wise median of param pytrees (f32, cast back per leaf)."""
-    stacked, proto = _stack_leaves(trees)
-    med = _median_tree(stacked)
+def _cast_like(out: Any, proto: Any) -> Any:
+    """Cast float leaves back to the contribution dtype; int leaves keep
+    the f32 result (same contract as ``fedavg._mean_leaf``: an int mean/
+    median stays the float it always was, never a truncated int)."""
     return jax.tree_util.tree_map(
-        lambda m, p: m.astype(p.dtype), med, proto
+        lambda m, p: m.astype(p.dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating)
+        else m,
+        out,
+        proto,
     )
+
+
+def tree_median(trees: Sequence[Any]) -> Any:
+    """Coordinate-wise median of param pytrees (f32; float leaves cast
+    back to their dtype, int leaves stay float — never truncated)."""
+    stacked, proto = _stack_leaves(trees)
+    return _cast_like(_median_tree(stacked), proto)
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -94,16 +112,23 @@ def tree_trimmed_mean(trees: Sequence[Any], *, trim: int) -> Any:
             f"(need n - 2*trim >= 1)"
         )
     stacked, proto = _stack_leaves(trees)
-    out = _tmean_tree(stacked, int(trim))
-    return jax.tree_util.tree_map(
-        lambda m, p: m.astype(p.dtype), out, proto
-    )
+    return _cast_like(_tmean_tree(stacked, int(trim)), proto)
 
 
 def _pairwise_sq_dists(flat: jax.Array) -> jax.Array:
-    """[n, d] → [n, n] squared euclidean distances."""
+    """[n, d] → [n, n] squared euclidean distances.
+
+    HIGHEST matmul precision: Krum *selects* by argmin over these
+    scores, so a bf16-class default matmul could flip a near-tied
+    selection between backends — a selection flip forks the global
+    model, unlike the ulp-level divergence a mean tolerates.  On a
+    heterogeneous fleet (mixed TPU/CPU controllers), run the selection
+    coordinator-side anyway (see the module docstring).
+    """
     sq = jnp.sum(flat**2, axis=1)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * jnp.matmul(
+        flat, flat.T, precision=jax.lax.Precision.HIGHEST
+    )
     return jnp.maximum(d2, 0.0)
 
 

@@ -36,6 +36,9 @@ def run_fedavg_rounds(
     checkpointer: Any = None,
     checkpoint_every: int = 0,
     on_round: Optional[Callable[[int, Any], None]] = None,
+    sample: Optional[int] = None,
+    sample_seed: int = 0,
+    aggregator: Optional[Callable[[Sequence[Any]], Any]] = None,
 ) -> Any:
     """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
 
@@ -56,6 +59,16 @@ def run_fedavg_rounds(
       ``checkpoint_every`` is left at 0, it defaults to 1 (every round)
       — a checkpointer that resumes but never saves is a misconfig.
     - ``on_round(i, params)``: called after each materialized round.
+    - ``sample``: partial participation — each round trains only a
+      deterministic pseudo-random subset of ``sample`` parties (seeded
+      by ``(sample_seed, round)``, so every controller draws the
+      IDENTICAL subset and the seq-id streams stay aligned).
+    - ``aggregator(values) -> tree``: replace the weighted mean with a
+      custom reducer over the round's fetched contributions — e.g.
+      :func:`rayfed_tpu.fl.tree_median`, ``functools.partial(
+      fl.tree_trimmed_mean, trim=1)``, or a Krum selection.
+      Materializes every round (the reducer needs raw values) and is
+      mutually exclusive with ``weights``.
 
     Without a server optimizer the rounds **pipeline**: the averaged
     model flows into the next round as a lazy ``FedObject`` (no
@@ -75,6 +88,20 @@ def run_fedavg_rounds(
         # A checkpointer with checkpoint_every=0 would resume but never
         # save — snapshot every round rather than silently never.
         checkpoint_every = 1
+    if aggregator is not None and weights is not None:
+        raise ValueError(
+            "aggregator and weights are mutually exclusive (a custom "
+            "reducer defines its own weighting)"
+        )
+    if sample is not None and not 1 <= int(sample) <= len(trainers):
+        raise ValueError(
+            f"sample must be in [1, {len(trainers)}], got {sample}"
+        )
+    if sample is not None and weights is not None:
+        raise ValueError(
+            "sample and weights are mutually exclusive (a weight "
+            "sequence cannot align with a changing per-round subset)"
+        )
 
     from rayfed_tpu.fed_object import FedObject
 
@@ -99,13 +126,28 @@ def run_fedavg_rounds(
         server_opt is None
         and on_round is None
         and not checkpoint_every
+        and aggregator is None  # a reducer needs the raw values
         and len(trainers) > 1
     )
 
     parties = list(trainers)
+
+    def round_parties(r: int):
+        if sample is None or sample == len(parties):
+            return parties
+        # Deterministic per-round subset: every controller draws the
+        # identical parties (same seed, same round) or the seq-id
+        # streams desync.  Sorted so the coordinator choice
+        # (objs[0].get_party() in pipelined mode) is order-stable.
+        import random as _random
+
+        rng = _random.Random(int(sample_seed) * 1_000_003 + r)
+        return sorted(rng.sample(parties, int(sample)))
+
     current: Any = params  # tree, or FedObject in pipelined rounds
 
     for r in range(start_round, rounds):
+        active = round_parties(r)
         # Wire form: a driver-held tree is compressed before the push;
         # a lazy FedObject from a pipelined round is already the
         # trainers' own (compressed) wire form.
@@ -114,7 +156,7 @@ def run_fedavg_rounds(
             if compress_wire and not isinstance(current, FedObject)
             else current
         )
-        updates = [trainers[p].train.remote(outgoing) for p in parties]
+        updates = [trainers[p].train.remote(outgoing) for p in active]
         if pipeline:
             last = r == rounds - 1
             current = aggregate(
@@ -127,7 +169,28 @@ def run_fedavg_rounds(
                 current = decompress(current)
             continue
 
-        avg = aggregate(updates, weights)
+        if aggregator is not None:
+            import rayfed_tpu as fed
+
+            if len(updates) > 2:
+                # Coordinator topology, like aggregate(mode=
+                # "coordinator"): contributions flow to ONE party which
+                # runs the reducer, and the result broadcasts on get —
+                # 2(N−1) transfers instead of the all-to-all N(N−1).
+                # Every controller holds the same `aggregator` callable
+                # (shared program), so only the coordinator executes it.
+                coord = updates[0].get_party()
+
+                def _reduce(*values):
+                    return aggregator(list(values))
+
+                avg = fed.get(
+                    fed.remote(_reduce).party(coord).remote(*updates)
+                )
+            else:
+                avg = aggregator(fed.get(updates))
+        else:
+            avg = aggregate(updates, weights)
         if compress_wire:
             avg = decompress(avg)
         if server_opt is not None:

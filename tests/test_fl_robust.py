@@ -118,3 +118,66 @@ def _run_robust_party(party, cluster=ROBUST_CLUSTER):
 
 def test_robust_aggregation_with_byzantine_party():
     run_parties(_run_robust_party, ["alice", "bob", "carol"], args=(ROBUST_CLUSTER,))
+
+
+# ---------------------------------------------------------------------------
+# Driver composition: robust aggregator + client sampling in the round loop
+# ---------------------------------------------------------------------------
+
+DRIVER_CLUSTER = make_cluster(["alice", "bob", "carol"])
+
+
+def _run_driver_robust_sampled(party, cluster=DRIVER_CLUSTER):
+    import functools
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import run_fedavg_rounds, tree_trimmed_mean
+
+    fed.init(address="local", cluster=cluster, party=party)
+    parties = ("alice", "bob", "carol")
+
+    @fed.remote
+    class Trainer:
+        def __init__(self, delta):
+            self._delta = delta
+
+        def train(self, p):
+            return {"w": p["w"] + self._delta}
+
+    # carol is Byzantine: giant updates every round she participates.
+    deltas = {"alice": 1.0, "bob": 1.0, "carol": 1e7}
+    trainers = {p: Trainer.party(p).remote(deltas[p]) for p in parties}
+    params = {"w": jnp.zeros((4,))}
+
+    # Robust aggregator (all 3 participate): trimmed mean drops carol's
+    # coordinate extremes every round -> the model advances by ~1/round.
+    out = run_fedavg_rounds(
+        trainers, params, rounds=3,
+        aggregator=functools.partial(tree_trimmed_mean, trim=1),
+    )
+    assert float(jnp.max(out["w"])) < 4.0, np.asarray(out["w"])
+
+    # Client sampling: 2 of 3 parties per round, deterministic across
+    # controllers (a mismatched draw would desync seq-ids and hang).
+    out2 = run_fedavg_rounds(
+        trainers, params, rounds=3, sample=2, sample_seed=7
+    )
+    assert np.all(np.isfinite(np.asarray(out2["w"])))
+
+    # Validation: weights can't align with a changing subset.
+    try:
+        run_fedavg_rounds(
+            trainers, params, rounds=1, sample=2, weights=[1.0, 2.0]
+        )
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "mutually exclusive" in str(e)
+    fed.shutdown()
+
+
+def test_driver_robust_aggregator_and_sampling():
+    run_parties(
+        _run_driver_robust_sampled,
+        ["alice", "bob", "carol"],
+        args=(DRIVER_CLUSTER,),
+    )
