@@ -73,12 +73,20 @@ def aggregate(
     mode: str = "auto",
     coordinator: Optional[str] = None,
     materialize: bool = True,
+    reducer: Optional[Any] = None,
 ):
-    """FedAvg round: fetch every party's update and average.
+    """FedAvg round: fetch every party's update and reduce (mean by default).
 
     ``fed_objects``: one FedObject per party (each owned by its producing
     party).  Every party calls this with the same list at the same point
     in the program, so all parties return the identical averaged tree.
+
+    ``reducer(values) -> tree`` replaces the weighted mean with a custom
+    reduction (e.g. :func:`rayfed_tpu.fl.tree_trimmed_mean` or a Krum
+    selection) over the round's contributions; it rides the SAME wire
+    topology the mean does (coordinator-side execution at N>2, one
+    reduce + broadcast), so there is exactly one place that decides who
+    talks to whom.  Mutually exclusive with ``weights``.
 
     Wire topology (``mode``):
 
@@ -104,6 +112,12 @@ def aggregate(
     """
     import rayfed_tpu as fed
 
+    if reducer is not None and weights is not None:
+        raise ValueError(
+            "reducer and weights are mutually exclusive (a custom "
+            "reducer defines its own weighting)"
+        )
+
     objs = list(fed_objects)
     if mode == "auto":
         # Pipelined (lazy) rounds only exist in coordinator topology, so
@@ -120,6 +134,8 @@ def aggregate(
                 "averages locally, which must fetch the contributions)"
             )
         values = fed.get(objs)
+        if reducer is not None:
+            return reducer(values)
         return tree_average(values, weights)
     if mode != "coordinator":
         raise ValueError(f"unknown aggregate mode {mode!r}")
@@ -127,10 +143,12 @@ def aggregate(
     coord = coordinator or objs[0].get_party()
     w = None if weights is None else tuple(float(x) for x in weights)
 
-    def _avg(*trees):
+    def _reduce(*trees):
+        if reducer is not None:
+            return reducer(list(trees))
         return tree_average(trees, w)
 
-    avg_obj = fed.remote(_avg).party(coord).remote(*objs)
+    avg_obj = fed.remote(_reduce).party(coord).remote(*objs)
     if not materialize:
         return avg_obj
     return fed.get(avg_obj)

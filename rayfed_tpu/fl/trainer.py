@@ -169,28 +169,10 @@ def run_fedavg_rounds(
                 current = decompress(current)
             continue
 
-        if aggregator is not None:
-            import rayfed_tpu as fed
-
-            if len(updates) > 2:
-                # Coordinator topology, like aggregate(mode=
-                # "coordinator"): contributions flow to ONE party which
-                # runs the reducer, and the result broadcasts on get —
-                # 2(N−1) transfers instead of the all-to-all N(N−1).
-                # Every controller holds the same `aggregator` callable
-                # (shared program), so only the coordinator executes it.
-                coord = updates[0].get_party()
-
-                def _reduce(*values):
-                    return aggregator(list(values))
-
-                avg = fed.get(
-                    fed.remote(_reduce).party(coord).remote(*updates)
-                )
-            else:
-                avg = aggregator(fed.get(updates))
-        else:
-            avg = aggregate(updates, weights)
+        # aggregate() owns the wire topology for both the mean and a
+        # custom reducer (coordinator-side reduce + broadcast at N>2) —
+        # one place decides who talks to whom.
+        avg = aggregate(updates, weights, reducer=aggregator)
         if compress_wire:
             avg = decompress(avg)
         if server_opt is not None:

@@ -104,6 +104,15 @@ class TransportClient:
         self._conns: List[_Conn] = []
         self._conn_lock = asyncio.Lock()
         self._pool_size = max(1, int(pool_size))
+        # Dedicated control connection for health pings: a data
+        # connection's write lock is held for a whole frame, so a ping
+        # queued on the pool behind a multi-GB push would time out and
+        # the health monitor would declare a busy-but-healthy peer
+        # dead.  Opened lazily on the first ctl ping only — one-shot
+        # readiness pings ride (and warm) the data pool instead.
+        self._ctl_conn: Optional[_Conn] = None
+        self._ctl_lock = asyncio.Lock()
+        self._closed = False
 
     # -- connection management ------------------------------------------------
 
@@ -144,6 +153,16 @@ class TransportClient:
                 self._conns.append(conn)
                 return conn
             return min(self._conns, key=lambda c: c.busy)
+
+    async def _acquire_ctl_conn(self) -> _Conn:
+        async with self._ctl_lock:
+            if self._closed:
+                # A ping racing close() must not resurrect a connection
+                # (and its reader task) that close() will never see.
+                raise SendError(f"client to {self._dest_party} closed")
+            if self._ctl_conn is None or self._ctl_conn.closed:
+                self._ctl_conn = await self._open_conn()
+            return self._ctl_conn
 
     async def _read_responses(self, conn: _Conn) -> None:
         try:
@@ -198,6 +217,10 @@ class TransportClient:
         conn.fd = None
 
     async def close(self) -> None:
+        self._closed = True
+        if self._ctl_conn is not None:
+            self._conns.append(self._ctl_conn)  # close with the rest
+            self._ctl_conn = None
         for conn in list(self._conns):
             if conn.reader_task is not None:
                 conn.reader_task.cancel()
@@ -214,8 +237,10 @@ class TransportClient:
     async def _roundtrip(
         self, msg_type: int, header: Dict[str, Any], payload_bufs: List,
         crc_trailer: bool = False, timeout_s: Optional[float] = None,
+        conn: Optional[_Conn] = None,
     ) -> Dict[str, Any]:
-        conn = await self._acquire_conn()
+        if conn is None:
+            conn = await self._acquire_conn()
         rid = next(self._rid)
         header = dict(header, rid=rid)
         loop = asyncio.get_running_loop()
@@ -443,12 +468,22 @@ class TransportClient:
             f"{policy.max_attempts} attempts: {last_exc}"
         )
 
-    async def ping(self, timeout_s: float = 1.0) -> bool:
+    async def ping(self, timeout_s: float = 1.0, ctl: bool = False) -> bool:
         """Readiness probe with a per-request deadline (no shared-state
-        mutation — concurrent sends keep their own timeout)."""
+        mutation — concurrent sends keep their own timeout).
+
+        ``ctl=True`` (the health monitor): ride the dedicated control
+        connection so the probe cannot queue behind a bulk payload write
+        on the data pool — which would read as "dead" exactly when the
+        peer is busiest.  Default (one-shot readiness pings): use the
+        data pool, warming a connection the first real send then reuses,
+        and leaving no extra long-lived socket behind when no monitor
+        runs."""
         try:
+            conn = await self._acquire_ctl_conn() if ctl else None
             await self._roundtrip(
-                wire.MSG_PING, {"src": self._src_party}, [], timeout_s=timeout_s
+                wire.MSG_PING, {"src": self._src_party}, [],
+                timeout_s=timeout_s, conn=conn,
             )
             return True
         except Exception:

@@ -148,7 +148,9 @@ class TransportManager:
         async def probe(party: str) -> bool:
             try:
                 return await asyncio.wait_for(
-                    self._get_client(party).ping(timeout_s=min(1.0, interval)),
+                    self._get_client(party).ping(
+                        timeout_s=min(1.0, interval), ctl=True
+                    ),
                     timeout=interval,
                 )
             except Exception:
@@ -171,6 +173,13 @@ class TransportManager:
             # (and thereby slow detection for) the others.
             results = await asyncio.gather(*(probe(p) for p in parties))
             for party, ok in zip(parties, results):
+                # A fresh delivery is liveness regardless of the ping: a
+                # party mid-bulk-transfer can be slow to answer control
+                # frames, but its arriving data proves it isn't dead.
+                if not ok and self._mailbox.seconds_since_delivery(
+                    party
+                ) <= interval:
+                    ok = True
                 if ok:
                     ever_reachable.add(party)
                     fails.pop(party, None)

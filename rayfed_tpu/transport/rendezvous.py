@@ -88,8 +88,12 @@ class Mailbox:
         # fail immediately until clear_party_failure.
         self._dead_parties: Dict[str, Dict[str, str]] = {}
         # Every party that ever delivered data here — evidence of
-        # reachability for the health monitor's loss-not-absence gate.
+        # reachability for the health monitor's loss-not-absence gate —
+        # and the monotonic time of each party's latest delivery (a
+        # fresh delivery IS liveness; the monitor must not count ping
+        # failures against a party whose data is actively arriving).
         self._seen_parties: set = set()
+        self._last_put: Dict[str, float] = {}
         self.stats: Dict[str, int] = {
             "dropped_duplicates": 0,
             "expired": 0,
@@ -99,6 +103,7 @@ class Mailbox:
     def put(self, message: Message) -> None:
         if message.error is None:
             self._seen_parties.add(message.src_party)
+            self._last_put[message.src_party] = time.monotonic()
         key = (message.upstream_seq_id, message.downstream_seq_id)
         if key in self._consumed:
             # Re-delivery of an already-consumed rendezvous (sender retry
@@ -197,6 +202,12 @@ class Mailbox:
     def seen_parties(self):
         """Parties that have delivered data to this mailbox."""
         return set(self._seen_parties)
+
+    def seconds_since_delivery(self, party: str) -> float:
+        """Monotonic seconds since ``party`` last delivered data
+        (``inf`` if never)."""
+        t = self._last_put.get(party)
+        return float("inf") if t is None else time.monotonic() - t
 
     def parties_with_waiters(self):
         """Parties that parked waiters currently expect data from."""

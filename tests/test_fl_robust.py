@@ -181,3 +181,39 @@ def test_driver_robust_aggregator_and_sampling():
         ["alice", "bob", "carol"],
         args=(DRIVER_CLUSTER,),
     )
+
+
+def _run_aggregate_reducer(party, cluster):
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import aggregate, tree_median
+
+    fed.init(address="local", cluster=cluster, party=party)
+    parties = ("alice", "bob", "carol")
+
+    @fed.remote
+    def make(v):
+        return {"w": jnp.full((4,), float(v))}
+
+    objs = [make.party(p).remote(i) for i, p in enumerate(parties)]
+    # N=3 -> auto coordinator: the reducer runs on ONE party (the first
+    # obj's owner) and the median broadcasts on get.
+    med = aggregate(objs, reducer=tree_median)
+    np.testing.assert_allclose(np.asarray(med["w"]), np.full((4,), 1.0))
+    # reducer + weights is rejected identically on every controller.
+    try:
+        aggregate(objs, weights=[1, 2, 3], reducer=tree_median)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "mutually exclusive" in str(e)
+    fed.shutdown()
+
+
+AGG_REDUCER_CLUSTER = make_cluster(["alice", "bob", "carol"])
+
+
+def test_aggregate_with_custom_reducer():
+    run_parties(
+        _run_aggregate_reducer,
+        ["alice", "bob", "carol"],
+        args=(AGG_REDUCER_CLUSTER,),
+    )
