@@ -520,5 +520,9 @@ class TransportManager:
     def get_stats(self) -> Dict[str, Any]:
         stats = dict(self.stats)
         stats.update(self._server.stats)
+        stats.update(self._mailbox.stats)  # dups, expiries, peer fails
         stats["pending_recvs"] = self._mailbox.pending_count()
+        # Snapshot, not the live dict: get_stats runs on user threads
+        # while the loop-thread health monitor mutates the dead set.
+        stats["dead_parties"] = sorted(self._mailbox.dead_parties_snapshot())
         return stats

@@ -94,6 +94,11 @@ class Mailbox:
         # failures against a party whose data is actively arriving).
         self._seen_parties: set = set()
         self._last_put: Dict[str, float] = {}
+        # Immutable snapshot of the dead set for CROSS-THREAD readers
+        # (get_stats polls from user threads; every other Mailbox method
+        # is loop-thread-only).  Replaced wholesale on each mutation, so
+        # a reader never iterates a dict the loop is resizing.
+        self._dead_snapshot: frozenset = frozenset()
         self.stats: Dict[str, int] = {
             "dropped_duplicates": 0,
             "expired": 0,
@@ -190,14 +195,20 @@ class Mailbox:
         self.stats["peer_failed_recvs"] += failed
         if poison_new:
             self._dead_parties[party] = dict(error)
+            self._dead_snapshot = frozenset(self._dead_parties)
         return failed
 
     def clear_party_failure(self, party: str) -> None:
         """The party is reachable again: stop failing new recvs on it."""
         self._dead_parties.pop(party, None)
+        self._dead_snapshot = frozenset(self._dead_parties)
 
     def dead_parties(self):
         return set(self._dead_parties)
+
+    def dead_parties_snapshot(self) -> frozenset:
+        """Cross-thread-safe view of the dead set (see _dead_snapshot)."""
+        return self._dead_snapshot
 
     def seen_parties(self):
         """Parties that have delivered data to this mailbox."""

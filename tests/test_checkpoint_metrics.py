@@ -129,4 +129,8 @@ def _stats_party_run(party, cluster):
         assert stats["send_bytes"] > 0, stats
     else:
         assert stats["receive_op_count"] >= 1, stats
+    # Mailbox observability rides along: dedup/expiry/fail-fast counters
+    # and the currently-poisoned party set.
+    assert stats["peer_failed_recvs"] == 0, stats
+    assert stats["dead_parties"] == [], stats
     fed.shutdown()
