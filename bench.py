@@ -34,6 +34,7 @@ accelerator configs; ``--compute-only`` skips the federated ones.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import glob
 import json
@@ -533,13 +534,16 @@ def _run_resnet_party(party: str, result_q, barrier=None) -> None:
     # the aggregate of ALL round-k trains, pipelined or not), so the
     # floor mirrors the treatment's per-round all-party dependency.
     def floor_leg(seed_bundle, floor_step, x_loc, y_loc):
-        barrier.wait()
+        # Bounded waits: a crashed sibling must break the barrier (and
+        # this child, which _multi_party detects) rather than stall the
+        # survivors until the harness's 900s timeout.
+        barrier.wait(timeout=300)
         fcpu0, ft0 = _cpu_seconds(), time.perf_counter()
         fb = seed_bundle
         for _ in range(rounds):
             fb, floss = floor_step(fb, x_loc, y_loc)
             jax.block_until_ready(floss)
-            barrier.wait()
+            barrier.wait(timeout=300)
         return rounds / (time.perf_counter() - ft0), (_cpu_seconds() - fcpu0) / rounds
 
     floor_rps = floor_cpu = float("nan")
@@ -1684,6 +1688,19 @@ def _prior_baseline(metric: str):
     return values[0] if values else None
 
 
+@contextlib.contextmanager
+def _section(extra: dict, name: str):
+    """Isolate one benchmark section: a failure records
+    ``{name}_error`` in the artifact and the remaining sections still
+    run and report — one bad section must not void a ~45-minute
+    one-shot round-end run."""
+    try:
+        yield
+    except Exception as e:
+        _log(f"  section {name} FAILED: {e!r}")
+        extra[f"{name}_error"] = repr(e)[:200]
+
+
 def main() -> None:
     fed_only = "--fed-only" in sys.argv
     compute_only = "--compute-only" in sys.argv
@@ -1691,6 +1708,7 @@ def main() -> None:
         raise SystemExit("--fed-only and --compute-only are mutually exclusive")
 
     extra: dict = {}
+    record = None
 
     # Environment fingerprint: cross-round comparisons of the federated
     # (CPU-bound) configs are only interpretable when the host is known —
@@ -1713,19 +1731,20 @@ def main() -> None:
     extra["env_device_kind"] = "uninitialized (--fed-only)"
 
     if not compute_only:
-        _log("1F1B + interleaved pipeline vs DP train step (4-device virtual mesh)...")
-        pp_t, ppi_t, dp_t = _one_child("_run_pp_vs_dp", ndev=4)
-        extra["pp_step_ms"] = round(pp_t * 1e3, 2)
-        extra["pp_interleaved_step_ms"] = round(ppi_t * 1e3, 2)
-        extra["dp_step_ms"] = round(dp_t * 1e3, 2)
-        extra["pp_vs_dp_step_ratio"] = round(dp_t / pp_t, 3)
-        extra["pp_interleaved_vs_dp_step_ratio"] = round(dp_t / ppi_t, 3)
-        _log(
-            f"  pp(1f1b) {pp_t*1e3:.1f} ms, pp(interleaved v=2) "
-            f"{ppi_t*1e3:.1f} ms vs dp {dp_t*1e3:.1f} ms (ratios "
-            f"{dp_t/pp_t:.3f} / {dp_t/ppi_t:.3f}; ideal bubble bounds "
-            f"0.57 / 0.73 at M=8,S=4)"
-        )
+        with _section(extra, "pp_bench"):
+            _log("1F1B + interleaved pipeline vs DP train step (4-device virtual mesh)...")
+            pp_t, ppi_t, dp_t = _one_child("_run_pp_vs_dp", ndev=4)
+            extra["pp_step_ms"] = round(pp_t * 1e3, 2)
+            extra["pp_interleaved_step_ms"] = round(ppi_t * 1e3, 2)
+            extra["dp_step_ms"] = round(dp_t * 1e3, 2)
+            extra["pp_vs_dp_step_ratio"] = round(dp_t / pp_t, 3)
+            extra["pp_interleaved_vs_dp_step_ratio"] = round(dp_t / ppi_t, 3)
+            _log(
+                f"  pp(1f1b) {pp_t*1e3:.1f} ms, pp(interleaved v=2) "
+                f"{ppi_t*1e3:.1f} ms vs dp {dp_t*1e3:.1f} ms (ratios "
+                f"{dp_t/pp_t:.3f} / {dp_t/ppi_t:.3f}; ideal bubble bounds "
+                f"0.57 / 0.73 at M=8,S=4)"
+            )
 
     if not compute_only:
         # Federated configs run lightest-first with a settle between
@@ -1736,140 +1755,145 @@ def main() -> None:
         def _settle():
             time.sleep(3)
 
-        _log("split-FL activation push (CPU parties, real transport)...")
-        sres = _multi_party("_run_split_party")
-        gbps = sum(v["gbps"] for v in sres.values()) / len(sres)
-        extra["split_fl_GBps"] = round(gbps, 3)
-        extra["split_fl_steps_per_sec"] = round(
-            sum(v["steps_per_sec"] for v in sres.values()) / len(sres), 3
-        )
-        extra["split_fl_bf16_steps_per_sec"] = round(
-            sum(v["bf16_steps_per_sec"] for v in sres.values()) / len(sres), 3
-        )
-        alice = sres.get("alice", next(iter(sres.values())))
-        extra["split_fl_wire_read_ms"] = round(alice["wire_read_ms"], 2)
-        extra["split_fl_send_path_ms"] = round(alice["send_path_ms"], 2)
-        extra["split_fl_other_ms"] = round(alice["other_ms"], 2)
-        split_compute_s = sum(v["compute_probe_ms"] for v in sres.values()) / 1e3
-        _log(
-            f"  split: {gbps:.3f} GB/s; per-step wire-read "
-            f"{alice['wire_read_ms']:.1f} ms, send-path "
-            f"{alice['send_path_ms']:.1f} ms, compute+sched "
-            f"{alice['other_ms']:.1f} ms; bf16 wire "
-            f"{extra['split_fl_bf16_steps_per_sec']:.2f} vs f32 "
-            f"{extra['split_fl_steps_per_sec']:.2f} steps/s"
-        )
-        _settle()
+        with _section(extra, "split_fl"):
+            _log("split-FL activation push (CPU parties, real transport)...")
+            sres = _multi_party("_run_split_party")
+            gbps = sum(v["gbps"] for v in sres.values()) / len(sres)
+            extra["split_fl_GBps"] = round(gbps, 3)
+            extra["split_fl_steps_per_sec"] = round(
+                sum(v["steps_per_sec"] for v in sres.values()) / len(sres), 3
+            )
+            extra["split_fl_bf16_steps_per_sec"] = round(
+                sum(v["bf16_steps_per_sec"] for v in sres.values()) / len(sres), 3
+            )
+            alice = sres.get("alice", next(iter(sres.values())))
+            extra["split_fl_wire_read_ms"] = round(alice["wire_read_ms"], 2)
+            extra["split_fl_send_path_ms"] = round(alice["send_path_ms"], 2)
+            extra["split_fl_other_ms"] = round(alice["other_ms"], 2)
+            extra["split_fl_compute_probe_s"] = round(
+                sum(v["compute_probe_ms"] for v in sres.values()) / 1e3, 4
+            )
+            _log(
+                f"  split: {gbps:.3f} GB/s; per-step wire-read "
+                f"{alice['wire_read_ms']:.1f} ms, send-path "
+                f"{alice['send_path_ms']:.1f} ms, compute+sched "
+                f"{alice['other_ms']:.1f} ms; bf16 wire "
+                f"{extra['split_fl_bf16_steps_per_sec']:.2f} vs f32 "
+                f"{extra['split_fl_steps_per_sec']:.2f} steps/s"
+            )
+            _settle()
 
         # Push bench AFTER the split section (lightest-first: its 128MB
         # floods would deflate a subsequent split window ~4x via socket
         # drain + page-cache churn) — the split ceiling is derived below
         # once both numbers exist.
-        _log("raw send-proxy push throughput (128MB sharded, loopback)...")
-        push, reshard = _one_child("_run_push_bench")
-        extra["push_GBps"] = round(push, 3)
-        extra["push_reshard_GBps"] = round(reshard, 3)
-        _log(f"  push: {push:.3f} GB/s wire, {reshard:.3f} GB/s with re-shard")
+        with _section(extra, "push_bench"):
+            _log("raw send-proxy push throughput (128MB sharded, loopback)...")
+            push, reshard = _one_child("_run_push_bench")
+            extra["push_GBps"] = round(push, 3)
+            extra["push_reshard_GBps"] = round(reshard, 3)
+            _log(f"  push: {push:.3f} GB/s wire, {reshard:.3f} GB/s with re-shard")
 
-        # Serialized 1-core model for the split step: every byte crosses
-        # the wire once and every FLOP runs once, all on one core —
-        # predicted steps/s = 1/(compute_s + bytes/wire_GBps).  Both
-        # terms measured (alice's serial local-compute probe of both
-        # halves + the push bench's wire GB/s), but each under slightly
-        # different conditions (the push bench moves 128MB sharded
-        # arrays; the split moves 16.8MB ones with cheaper per-byte
-        # cost), so the model is a sanity reference, good to ~±15%: a
-        # measured number far BELOW it flags a real pathology (r4's
-        # 0.056 GB/s would have read ~0.1 of model), slightly above it
-        # just means the wire term was conservative.
-        step_bytes = (
-            extra["split_fl_GBps"] * 1e9 / extra["split_fl_steps_per_sec"]
-            if extra["split_fl_steps_per_sec"]
-            else 0.0
-        )
-        if push > 0 and (split_compute_s > 0 or step_bytes > 0):
-            wire_s = step_bytes / (push * 1e9)
-            ceiling_sps = 1.0 / (split_compute_s + wire_s)
-            extra["split_fl_ceiling_steps_per_sec"] = round(ceiling_sps, 3)
-            extra["split_fl_vs_ceiling"] = round(
-                extra["split_fl_steps_per_sec"] / ceiling_sps, 3
-            )
-            _log(
-                f"  split serialized model: {ceiling_sps:.2f} steps/s "
-                f"(compute {split_compute_s*1e3:.0f} ms + wire "
-                f"{wire_s*1e3:.0f} ms) -> measured f32 is "
-                f"{extra['split_fl_vs_ceiling']} of it"
-            )
-        else:
+            # Serialized 1-core model for the split step: every byte
+            # crosses the wire once and every FLOP runs once, all on one
+            # core — predicted steps/s = 1/(compute_s + bytes/wire_GBps).
+            # Both terms measured (alice's serial local-compute probe of
+            # both halves + the push bench's wire GB/s), but each under
+            # slightly different conditions (the push bench moves 128MB
+            # sharded arrays; the split moves 16.8MB ones with cheaper
+            # per-byte cost), so the model is a sanity reference, good
+            # to ~±15%: a measured number far BELOW it flags a real
+            # pathology (r4's 0.056 GB/s would have read ~0.1 of model),
+            # slightly above it just means the wire term was
+            # conservative.  Reads only `extra` so a failed split
+            # section degrades to None fields, not a mislabeled
+            # push_bench_error.
+            split_compute_s = extra.get("split_fl_compute_probe_s")
+            split_sps = extra.get("split_fl_steps_per_sec")
+            split_gbps = extra.get("split_fl_GBps")
             extra["split_fl_ceiling_steps_per_sec"] = None
             extra["split_fl_vs_ceiling"] = None
+            if push > 0 and split_compute_s and split_sps and split_gbps:
+                step_bytes = split_gbps * 1e9 / split_sps
+                wire_s = step_bytes / (push * 1e9)
+                ceiling_sps = 1.0 / (split_compute_s + wire_s)
+                extra["split_fl_ceiling_steps_per_sec"] = round(ceiling_sps, 3)
+                extra["split_fl_vs_ceiling"] = round(split_sps / ceiling_sps, 3)
+                _log(
+                    f"  split serialized model: {ceiling_sps:.2f} steps/s "
+                    f"(compute {split_compute_s*1e3:.0f} ms + wire "
+                    f"{wire_s*1e3:.0f} ms) -> measured f32 is "
+                    f"{extra['split_fl_vs_ceiling']} of it"
+                )
         _settle()
 
-        _log("2-party Llama-LoRA federated fine-tune (CPU parties)...")
-        lres = _multi_party("_run_lora_party")
-        lrps = sum(v[0] for v in lres.values()) / len(lres)
-        adapter_mb = next(iter(lres.values()))[1]
-        extra["lora_2party_rounds_per_sec"] = round(lrps, 3)
-        extra["lora_adapter_MB_per_push"] = round(adapter_mb, 3)
-        _log(f"  lora: {lrps:.3f} rounds/s, {adapter_mb:.3f} MB adapters/push")
-        _settle()
+        with _section(extra, "lora_2party"):
+            _log("2-party Llama-LoRA federated fine-tune (CPU parties)...")
+            lres = _multi_party("_run_lora_party")
+            lrps = sum(v[0] for v in lres.values()) / len(lres)
+            adapter_mb = next(iter(lres.values()))[1]
+            extra["lora_2party_rounds_per_sec"] = round(lrps, 3)
+            extra["lora_adapter_MB_per_push"] = round(adapter_mb, 3)
+            _log(f"  lora: {lrps:.3f} rounds/s, {adapter_mb:.3f} MB adapters/push")
+            _settle()
 
-        _log("4-party ResNet-18 FedAvg (CPU parties, real transport)...")
-        res = _multi_party(
-            "_run_resnet_party", RESNET_PARTIES, ndev=1, use_barrier=True
-        )
-        rps = sum(v[0] for v in res.values()) / len(res)
-        xgbps = sum(v[1] for v in res.values()) / len(res)
-        extra["resnet_4party_rounds_per_sec"] = round(rps, 3)
-        extra["cross_party_GBps"] = round(xgbps, 3)
-        # Coordinator's per-round wire decomposition (alice aggregates).
-        coord = res.get("alice", next(iter(res.values())))
-        extra["resnet_coord_wire_read_ms"] = round(coord[2], 2)
-        extra["resnet_coord_send_path_ms"] = round(coord[3], 2)
-        # cross_party_GBps above divides bundle bytes by the WHOLE round
-        # (≥95% compute) — it is goodput, not wire speed.  The wire-
-        # session rate divides the coordinator's bytes by its actual
-        # read+send session time.
-        coord_bytes_per_round = coord[1] * 1e9 * coord[6]
-        wire_session_s = (coord[2] + coord[3]) / 1e3
-        if wire_session_s > 0:
-            extra["cross_party_wire_GBps"] = round(
-                coord_bytes_per_round / wire_session_s / 1e9, 3
+        with _section(extra, "resnet_fedavg"):
+            _log("4-party ResNet-18 FedAvg (CPU parties, real transport)...")
+            res = _multi_party(
+                "_run_resnet_party", RESNET_PARTIES, ndev=1, use_barrier=True
             )
-        # Full decomposition: step wall (jitted local round incl. fused
-        # wire casts), per-party CPU, and idle share.  step/wall ≈ 96%
-        # on the 1-core host — the rest is transport CPU + idle.
-        step_ms = sum(v[4] for v in res.values()) / len(res)
-        cpu_pr = sum(v[5] for v in res.values())
-        wall_pr = sum(v[6] for v in res.values()) / len(res)
-        extra["resnet_round_step_ms"] = round(step_ms, 1)
-        extra["resnet_round_cpu_s_total"] = round(cpu_pr, 2)
-        extra["resnet_round_busy_frac"] = round(cpu_pr / wall_pr, 3)
-        extra["resnet_decomp_step_frac"] = round(step_ms / 1e3 / wall_pr, 3)
-        _log(
-            f"  resnet: {rps:.3f} rounds/s, {xgbps:.3f} GB/s cross-party; "
-            f"coordinator wire-read {coord[2]:.1f} ms + send "
-            f"{coord[3]:.1f} ms per round; step {step_ms/1e3:.2f}s of "
-            f"{wall_pr:.2f}s wall ({step_ms/1e3/wall_pr:.0%}), "
-            f"4-party CPU {cpu_pr:.2f}s ({cpu_pr/wall_pr:.0%} busy)"
-        )
-        _settle()
+            rps = sum(v[0] for v in res.values()) / len(res)
+            xgbps = sum(v[1] for v in res.values()) / len(res)
+            extra["resnet_4party_rounds_per_sec"] = round(rps, 3)
+            extra["cross_party_GBps"] = round(xgbps, 3)
+            # Coordinator's per-round wire decomposition (alice aggregates).
+            coord = res.get("alice", next(iter(res.values())))
+            extra["resnet_coord_wire_read_ms"] = round(coord[2], 2)
+            extra["resnet_coord_send_path_ms"] = round(coord[3], 2)
+            # cross_party_GBps above divides bundle bytes by the WHOLE round
+            # (≥95% compute) — it is goodput, not wire speed.  The wire-
+            # session rate divides the coordinator's bytes by its actual
+            # read+send session time.
+            coord_bytes_per_round = coord[1] * 1e9 * coord[6]
+            wire_session_s = (coord[2] + coord[3]) / 1e3
+            if wire_session_s > 0:
+                extra["cross_party_wire_GBps"] = round(
+                    coord_bytes_per_round / wire_session_s / 1e9, 3
+                )
+            # Full decomposition: step wall (jitted local round incl. fused
+            # wire casts), per-party CPU, and idle share.  step/wall ≈ 96%
+            # on the 1-core host — the rest is transport CPU + idle.
+            step_ms = sum(v[4] for v in res.values()) / len(res)
+            cpu_pr = sum(v[5] for v in res.values())
+            wall_pr = sum(v[6] for v in res.values()) / len(res)
+            extra["resnet_round_step_ms"] = round(step_ms, 1)
+            extra["resnet_round_cpu_s_total"] = round(cpu_pr, 2)
+            extra["resnet_round_busy_frac"] = round(cpu_pr / wall_pr, 3)
+            extra["resnet_decomp_step_frac"] = round(step_ms / 1e3 / wall_pr, 3)
+            _log(
+                f"  resnet: {rps:.3f} rounds/s, {xgbps:.3f} GB/s cross-party; "
+                f"coordinator wire-read {coord[2]:.1f} ms + send "
+                f"{coord[3]:.1f} ms per round; step {step_ms/1e3:.2f}s of "
+                f"{wall_pr:.2f}s wall ({step_ms/1e3/wall_pr:.0%}), "
+                f"4-party CPU {cpu_pr:.2f}s ({cpu_pr/wall_pr:.0%} busy)"
+            )
+            _settle()
 
-        # Contention floor: measured inside the same four party
-        # processes immediately after the fedavg window (see
-        # _run_resnet_party) — bare local rounds, no framework,
-        # mp-Barrier-synced per round.  Same processes + same host
-        # moment makes fedavg/floor drift-free.
-        floor_rps = sum(v[7] for v in res.values()) / len(res)
-        floor_cpu = sum(v[8] for v in res.values())
-        extra["resnet_compute_floor_rounds_per_sec"] = round(floor_rps, 3)
-        extra["resnet_floor_cpu_s_total"] = round(floor_cpu, 2)
-        extra["resnet_fedavg_overhead_ratio"] = round(rps / floor_rps, 3)
-        _log(
-            f"  floor (fed local program, in-process): {floor_rps:.3f} "
-            f"rounds/s ({floor_cpu:.2f}s CPU per round across 4 procs); "
-            f"fedavg/floor {rps / floor_rps:.3f} (framework share)"
-        )
+            # Contention floor: measured inside the same four party
+            # processes immediately after the fedavg window (see
+            # _run_resnet_party) — bare local rounds, no framework,
+            # mp-Barrier-synced per round.  Same processes + same host
+            # moment makes fedavg/floor drift-free.
+            floor_rps = sum(v[7] for v in res.values()) / len(res)
+            floor_cpu = sum(v[8] for v in res.values())
+            extra["resnet_compute_floor_rounds_per_sec"] = round(floor_rps, 3)
+            extra["resnet_floor_cpu_s_total"] = round(floor_cpu, 2)
+            extra["resnet_fedavg_overhead_ratio"] = round(rps / floor_rps, 3)
+            _log(
+                f"  floor (fed local program, in-process): {floor_rps:.3f} "
+                f"rounds/s ({floor_cpu:.2f}s CPU per round across 4 procs); "
+                f"fedavg/floor {rps / floor_rps:.3f} (framework share)"
+            )
 
         # North-star ratio (BASELINE.json #3): fedavg vs the single-
         # process data-parallel control at the same total batch.  On a
@@ -1879,31 +1903,42 @@ def main() -> None:
         # is framework overhead, and all of which vanish on real
         # hardware where each party owns its chips and the per-device
         # batch matches.
-        _log("ResNet-18 single-process DP control (north-star denominator)...")
-        dp_rps, dp_cpu = _one_child("_run_resnet_dp_control", ndev=1)
-        extra["resnet_dp_control_rounds_per_sec"] = round(dp_rps, 3)
-        extra["resnet_dp_cpu_s"] = round(dp_cpu, 2)
-        extra["resnet_fedavg_vs_dp_ratio"] = round(rps / dp_rps, 3)
-        extra["resnet_batch_efficiency_ratio"] = round(dp_cpu / floor_cpu, 3)
-        _log(
-            f"  dp control: {dp_rps:.3f} rounds/s ({dp_cpu:.2f}s CPU) -> "
-            f"fedavg/dp ratio {rps / dp_rps:.3f}; floor/dp "
-            f"{floor_rps / dp_rps:.3f} (structural: dp does the same "
-            f"epoch in {dp_cpu:.1f}s CPU vs the 4 parties' "
-            f"{floor_cpu:.1f}s)"
-        )
-        _settle()
+        with _section(extra, "resnet_dp"):
+            _log("ResNet-18 single-process DP control (north-star denominator)...")
+            dp_rps, dp_cpu = _one_child("_run_resnet_dp_control", ndev=1)
+            extra["resnet_dp_control_rounds_per_sec"] = round(dp_rps, 3)
+            extra["resnet_dp_cpu_s"] = round(dp_cpu, 2)
+            # Cross-section ratios only when the fedavg section produced
+            # its numbers — a fedavg failure must not fail the dp
+            # control that just measured fine.
+            fed_rps = extra.get("resnet_4party_rounds_per_sec")
+            fl_rps = extra.get("resnet_compute_floor_rounds_per_sec")
+            fl_cpu = extra.get("resnet_floor_cpu_s_total")
+            if fed_rps and fl_rps and fl_cpu:
+                extra["resnet_fedavg_vs_dp_ratio"] = round(fed_rps / dp_rps, 3)
+                extra["resnet_batch_efficiency_ratio"] = round(dp_cpu / fl_cpu, 3)
+                _log(
+                    f"  dp control: {dp_rps:.3f} rounds/s ({dp_cpu:.2f}s CPU) "
+                    f"-> fedavg/dp ratio {fed_rps / dp_rps:.3f}; floor/dp "
+                    f"{fl_rps / dp_rps:.3f} (structural: dp does the same "
+                    f"epoch in {dp_cpu:.1f}s CPU vs the 4 parties' "
+                    f"{fl_cpu:.1f}s)"
+                )
+            else:
+                _log(f"  dp control: {dp_rps:.3f} rounds/s ({dp_cpu:.2f}s CPU)")
+            _settle()
 
-        metric = "fedavg_mnist_2party_rounds_per_sec"
-        _log("2-party FedAvg (CPU parties, real transport)...")
-        rps = _two_party("_run_fedavg_party")
-        prior = _prior_baseline(metric)
-        record = {
-            "metric": metric,
-            "value": round(rps, 3),
-            "unit": "rounds/s",
-            "vs_baseline": round(rps / prior, 3) if prior else 1.0,
-        }
+        with _section(extra, "fedavg_mnist"):
+            metric = "fedavg_mnist_2party_rounds_per_sec"
+            _log("2-party FedAvg (CPU parties, real transport)...")
+            rps = _two_party("_run_fedavg_party")
+            prior = _prior_baseline(metric)
+            record = {
+                "metric": metric,
+                "value": round(rps, 3),
+                "unit": "rounds/s",
+                "vs_baseline": round(rps / prior, 3) if prior else 1.0,
+            }
     if not fed_only:
         try:
             extra["env_device_kind"] = jax.devices()[0].device_kind
@@ -1916,24 +1951,27 @@ def main() -> None:
             fed_only = True
     if not fed_only:
         _log(f"compute benches on {extra['env_device_kind']}...")
-        extra.update(bench_llama())
-        _log(f"  llama: {extra}")
-        extra.update(bench_decode())
-        _log(f"  decode: {extra}")
-        extra.update(bench_flash())
-        _log(f"  flash: {extra}")
-        try:
+        with _section(extra, "llama_train"):
+            extra.update(bench_llama())
+            _log(f"  llama: {extra}")
+        with _section(extra, "decode"):
+            extra.update(bench_decode())
+            _log(f"  decode: {extra}")
+        with _section(extra, "flash"):
+            extra.update(bench_flash())
+            _log(f"  flash: {extra}")
+        # The 8B config needs ~11 GB of HBM; smaller devices (or the
+        # CPU fallback in CI) record the failure instead of dying.
+        with _section(extra, "lora_8b"):
             extra.update(bench_lora_8b())
             _log(f"  lora-8b: {extra}")
-        except Exception as e:  # pragma: no cover - 16GB-chip dependent
-            # The 8B config needs ~11 GB of HBM; smaller devices (or the
-            # CPU fallback in CI) record the failure instead of dying.
-            _log(f"  lora-8b skipped: {e!r}")
-            extra["lora_8b_error"] = repr(e)[:200]
-        extra.update(bench_moe())
-        _log(f"  moe: {extra}")
+        with _section(extra, "moe"):
+            extra.update(bench_moe())
+            _log(f"  moe: {extra}")
 
-    if compute_only:
+    if record is None:
+        # compute_only, or the headline federated section failed (its
+        # error is in extra) — fall back to the llama headline.
         record = {
             "metric": "llama_tokens_per_sec",
             "value": extra.get("llama_tokens_per_sec", 0.0),
