@@ -5,7 +5,9 @@ concurrent send/recv rendezvous, metadata propagation, message-size caps,
 and retry-policy failure when the peer never starts.
 """
 
+import asyncio
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -261,3 +263,99 @@ def test_streamed_sharded_transfer_end_to_end():
             assert mgr._server.stats.get("receive_crc_errors", 0) == 0
     finally:
         mgr.stop()
+
+
+def test_mailbox_fail_party_semantics():
+    """Component-level peer-death fail-fast: fail_party poisons exactly
+    the waiters expecting that party, poisons NEW recvs until cleared,
+    and prefers real data that raced in first."""
+    from rayfed_tpu.exceptions import RemoteError
+
+    cluster = _self_cluster()
+    mgr = TransportManager(
+        cluster, JobConfig(device_put_received=False, peer_failfast=False)
+    )
+    mgr.start()
+    try:
+        mailbox = mgr._mailbox
+        err = RemoteError("bob", "ConnectionError", "gone").to_wire()
+
+        def on_loop(fn, *args):
+            """Run a loop-thread-only Mailbox method and return its value."""
+
+            async def _call():
+                return fn(*args)
+
+            return asyncio.run_coroutine_threadsafe(_call(), mgr._loop).result(10)
+
+        # Parked waiters for two different parties.
+        ref_bob = mgr.recv("bob", "u1", "d1")
+        ref_carol = mgr.recv("carol", "u2", "d2")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if on_loop(mailbox.parties_with_waiters) == {"bob", "carol"}:
+                break
+            time.sleep(0.02)
+        assert on_loop(mailbox.parties_with_waiters) == {"bob", "carol"}
+
+        on_loop(mailbox.fail_party, "bob", err)
+        with pytest.raises(RemoteError, match="bob"):
+            ref_bob.resolve(timeout=10)
+        # carol's waiter is untouched; bob is in the dead snapshot.
+        assert on_loop(mailbox.parties_with_waiters) == {"carol"}
+        assert mailbox.dead_parties_snapshot() == frozenset({"bob"})
+        assert mgr.get_stats()["dead_parties"] == ["bob"]
+
+        # A NEW recv on the dead party fails immediately.
+        with pytest.raises(RemoteError, match="bob"):
+            mgr.recv("bob", "u3", "d3").resolve(timeout=10)
+
+        # Clearing un-poisons: the next recv parks again (and then gets
+        # real data via a send to self... carol's waiter drains last).
+        on_loop(mailbox.clear_party_failure, "bob")
+        assert mailbox.dead_parties_snapshot() == frozenset()
+        # No data has been delivered by anyone yet.
+        assert on_loop(mailbox.seconds_since_delivery, "alice") == float("inf")
+        # The recovery is real, not just the snapshot: a new recv on bob
+        # PARKS again (no immediate poison) and consumes data normally.
+        ref_bob2 = mgr.recv("bob", "u4", "d4")
+        assert mgr.send("alice", np.full((4,), 7.0), "u4", "d4").resolve(
+            timeout=30
+        ) is True
+        np.testing.assert_allclose(ref_bob2.resolve(timeout=30), 7.0)
+
+        # Data for carol's waiter proves delivery-liveness tracking.
+        assert mgr.send("alice", np.ones(8), "u2", "d2").resolve(
+            timeout=30
+        ) is True
+        val = ref_carol.resolve(timeout=30)
+        assert val.shape == (8,)
+        # (the sender of that data is "alice" — the self-party — so its
+        # delivery clock started; carol never delivered.)
+        assert on_loop(mailbox.seconds_since_delivery, "alice") < 60
+        assert on_loop(mailbox.seconds_since_delivery, "carol") == float("inf")
+    finally:
+        mgr.stop()
+
+
+def test_ping_ctl_connection(manager):
+    """ctl pings ride a dedicated connection, and close() bars its
+    resurrection."""
+    client = manager._get_client("alice")
+    ok = asyncio.run_coroutine_threadsafe(
+        client.ping(timeout_s=2.0, ctl=True), manager._loop
+    ).result(timeout=10)
+    assert ok is True
+    assert client._ctl_conn is not None
+    # Data-pool pings don't touch the ctl connection.
+    ctl_before = client._ctl_conn
+    ok2 = asyncio.run_coroutine_threadsafe(
+        client.ping(timeout_s=2.0), manager._loop
+    ).result(timeout=10)
+    assert ok2 is True and client._ctl_conn is ctl_before
+    # After close(), a racing ctl ping cannot resurrect a connection.
+    asyncio.run_coroutine_threadsafe(client.close(), manager._loop).result(10)
+    ok3 = asyncio.run_coroutine_threadsafe(
+        client.ping(timeout_s=1.0, ctl=True), manager._loop
+    ).result(timeout=10)
+    assert ok3 is False and client._ctl_conn is None
