@@ -25,6 +25,14 @@ def test_split_fl_bert_example():
     run_parties(run_split_example, ["alice", "bob"], args=(2,), timeout=240)
 
 
+def test_robust_fedavg_example():
+    from examples.robust_fedavg import run as run_robust_example
+
+    run_parties(
+        run_robust_example, ["alice", "bob", "carol"], args=(3,), timeout=240
+    )
+
+
 def test_mesh_fedavg_example():
     from examples.mesh_fedavg import run as run_mesh_example
 
