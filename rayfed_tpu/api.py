@@ -393,7 +393,7 @@ def get(
         return fed_objects.resolve(timeout=timeout)
 
     runtime = get_runtime()
-    from rayfed_tpu.proxy import recv_on_runtime, send_on_runtime
+    from rayfed_tpu.proxy import recv_on_runtime, send_many_on_runtime
 
     # Fake fed_task_id allocated on EVERY party to keep counters aligned
     # (ref api.py:368) — the determinism contract.
@@ -413,18 +413,23 @@ def get(
             local_ref = fed_object.get_local_ref()
             assert local_ref is not None
             refs.append(local_ref)
-            for party_name in cluster_parties:
-                if party_name == current_party:
-                    continue
-                # Exactly-once broadcast dedup (ref api.py:389-394).
-                if fed_object._mark_if_not_sending_to_party(party_name):
-                    send_on_runtime(
-                        runtime,
-                        dest_party=party_name,
-                        data=local_ref,
-                        upstream_seq_id=fed_object.get_fed_task_id(),
-                        downstream_seq_id=fake_fed_task_id,
-                    )
+            # Exactly-once broadcast dedup (ref api.py:389-394), then one
+            # fan-out push: the payload is encoded/checksummed once and
+            # streamed to every pending peer concurrently.
+            pending = [
+                party_name
+                for party_name in cluster_parties
+                if party_name != current_party
+                and fed_object._mark_if_not_sending_to_party(party_name)
+            ]
+            if pending:
+                send_many_on_runtime(
+                    runtime,
+                    dest_parties=pending,
+                    data=local_ref,
+                    upstream_seq_id=fed_object.get_fed_task_id(),
+                    downstream_seq_id=fake_fed_task_id,
+                )
         else:
             cached = fed_object.get_local_ref()
             if cached is not None:

@@ -369,6 +369,19 @@ class MultiHostTransport:
         # Non-leader: the leader's identical program does the real push.
         return LocalRef.from_value(True)
 
+    def send_many(self, dest_parties, data, upstream_seq_id,
+                  downstream_seq_id):
+        """Fan-out broadcast (one shared encode) — leader only; see
+        :meth:`TransportManager.send_many`."""
+        if self._inner is not None:
+            return self._inner.send_many(
+                dest_parties=dest_parties,
+                data=data,
+                upstream_seq_id=upstream_seq_id,
+                downstream_seq_id=downstream_seq_id,
+            )
+        return {p: LocalRef.from_value(True) for p in dest_parties}
+
     def recv(self, src_party, upstream_seq_id, downstream_seq_id):
         if self._inner is not None:
             return self._inner.recv(

@@ -69,6 +69,50 @@ class LocalRef:
     def add_done_callback(self, fn: Callable[["LocalRef"], None]) -> None:
         self._future.add_done_callback(lambda _f: fn(self))
 
+    def then(
+        self,
+        fn: Callable[[Any], Any],
+        executor: Optional[concurrent.futures.Executor] = None,
+    ) -> "LocalRef":
+        """Chain ``fn`` onto this ref without parking a thread.
+
+        Returns a new LocalRef resolving to ``fn(value)``; an exception
+        (from this ref or from ``fn``) propagates to the returned ref.
+        With ``executor``, ``fn`` runs there instead of on the completing
+        thread — e.g. the transport decodes received payloads on its
+        codec pool rather than the event loop.
+        """
+        out = LocalRef()
+
+        def _run(value: Any) -> None:
+            try:
+                out.set_result(fn(value))
+            except BaseException as e:
+                out.set_exception(e)
+
+        def _cb(ref: "LocalRef") -> None:
+            try:
+                exc = ref.exception()
+            except BaseException as e:
+                # exception() on a CANCELLED future raises instead of
+                # returning (e.g. shutdown cancelling a parked recv) —
+                # the chained ref must still resolve or callers hang.
+                out.set_exception(e)
+                return
+            if exc is not None:
+                out.set_exception(exc)
+                return
+            if executor is not None:
+                try:
+                    executor.submit(_run, ref.resolve())
+                except BaseException as e:  # pool shut down mid-flight
+                    out.set_exception(e)
+            else:
+                _run(ref.resolve())
+
+        self.add_done_callback(_cb)
+        return out
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"LocalRef(done={self._future.done()})"
 

@@ -18,7 +18,13 @@ Here the common algorithms ship with the framework:
   trimmed mean, Krum/multi-Krum) bounding any single party's influence.
 """
 
-from rayfed_tpu.fl.compression import compress, decompress
+from rayfed_tpu.fl.compression import (
+    PackedTree,
+    compress,
+    decompress,
+    pack_tree,
+    unpack_tree,
+)
 from rayfed_tpu.fl.dp import clip_by_global_norm, privatize
 from rayfed_tpu.fl.fedavg import aggregate, tree_average, tree_weighted_sum
 from rayfed_tpu.fl.fedopt import (
@@ -44,6 +50,9 @@ __all__ = [
     "SplitTrainer",
     "compress",
     "decompress",
+    "PackedTree",
+    "pack_tree",
+    "unpack_tree",
     "server_sgd",
     "server_adam",
     "server_yogi",

@@ -25,6 +25,24 @@ from rayfed_tpu.fl.fedavg import aggregate
 from rayfed_tpu.fl.fedopt import ServerOptimizer
 
 
+def sample_parties(
+    parties: Sequence[str], sample: int, sample_seed: int, round_index: int
+) -> list:
+    """The per-round participation draw, shared by every controller.
+
+    Draws from the **sorted** party list: the population order must be
+    canonical, not dict insertion order — two controllers that built
+    their ``trainers`` mapping in different orders would otherwise draw
+    DIFFERENT subsets from the identical seed (``rng.sample`` picks by
+    index), desyncing the seq-id streams into a hang.  The result is
+    sorted too, so coordinator choice is order-stable.
+    """
+    import random as _random
+
+    rng = _random.Random(int(sample_seed) * 1_000_003 + round_index)
+    return sorted(rng.sample(sorted(parties), int(sample)))
+
+
 def run_fedavg_rounds(
     trainers: dict,
     params: Any,
@@ -33,6 +51,7 @@ def run_fedavg_rounds(
     server_opt: Optional[ServerOptimizer] = None,
     weights: Optional[Sequence[float]] = None,
     compress_wire: bool = False,
+    packed_wire: bool = False,
     checkpointer: Any = None,
     checkpoint_every: int = 0,
     on_round: Optional[Callable[[int, Any], None]] = None,
@@ -54,6 +73,12 @@ def run_fedavg_rounds(
       ``compress(updated)`` — in pipelined rounds the averaged bf16
       tree flows straight back into ``train``; the driver decompresses
       only what it returns or feeds the server optimizer.
+    - ``packed_wire``: with ``compress_wire``, use the packed single-
+      buffer wire form (:class:`~rayfed_tpu.fl.PackedTree`): one fused
+      cast kernel instead of per-leaf casts, one contiguous wire buffer
+      instead of one per leaf.  ``decompress`` on the trainer side
+      accepts either form transparently; trainers returning
+      ``compress(updated, packed=True)`` keep the fast path end-to-end.
     - ``checkpointer``: a :class:`rayfed_tpu.checkpoint.FedCheckpointer`;
       resume happens automatically from its latest complete round.  If
       ``checkpoint_every`` is left at 0, it defaults to 1 (every round)
@@ -137,12 +162,9 @@ def run_fedavg_rounds(
             return parties
         # Deterministic per-round subset: every controller draws the
         # identical parties (same seed, same round) or the seq-id
-        # streams desync.  Sorted so the coordinator choice
-        # (objs[0].get_party() in pipelined mode) is order-stable.
-        import random as _random
-
-        rng = _random.Random(int(sample_seed) * 1_000_003 + r)
-        return sorted(rng.sample(parties, int(sample)))
+        # streams desync — see sample_parties for the canonical-order
+        # contract.
+        return sample_parties(parties, int(sample), sample_seed, r)
 
     current: Any = params  # tree, or FedObject in pipelined rounds
 
@@ -152,7 +174,7 @@ def run_fedavg_rounds(
         # a lazy FedObject from a pipelined round is already the
         # trainers' own (compressed) wire form.
         outgoing = (
-            compress(current)
+            compress(current, packed=packed_wire)
             if compress_wire and not isinstance(current, FedObject)
             else current
         )

@@ -258,24 +258,43 @@ def make_fed_train_step(
 
     ``(wire_bundle, x, y) -> (wire_bundle, loss)`` where ``wire_bundle``
     is the ``(params, state)`` tree in ``wire_dtype`` exactly as it
-    crosses parties (:mod:`rayfed_tpu.fl.compression` form).  The
-    decompress (wire→f32), fresh-momentum init, ``local_steps`` SGD
-    steps, and recompress (f32→wire) all live INSIDE the jit, so XLA
-    fuses the casts into adjacent ops instead of the caller paying
-    ~2×|params| of separate elementwise passes plus per-leaf dispatch
-    per round — the difference matters when a round is seconds, not
-    minutes (BASELINE.md #3's ≥0.9-of-floor target).
+    crosses parties (:mod:`rayfed_tpu.fl.compression` form) — EITHER the
+    per-leaf tree OR the packed single-buffer form
+    (:class:`~rayfed_tpu.fl.PackedTree`); the step returns the same form
+    it was given.  The decompress (wire→f32), fresh-momentum init,
+    ``local_steps`` SGD steps, and recompress (f32→wire) all live INSIDE
+    the jit, so XLA fuses the casts into adjacent ops instead of the
+    caller paying ~2×|params| of separate elementwise passes plus
+    per-leaf dispatch per round — the difference matters when a round is
+    seconds, not minutes (BASELINE.md #3's ≥0.9-of-floor target).  With
+    a packed bundle the whole model additionally enters and leaves the
+    step as ONE buffer — the form the wire pushes zero-copy.
     """
-    from rayfed_tpu.fl.compression import cast_floats
+    from rayfed_tpu.fl.compression import (
+        PackedTree,
+        cast_floats,
+        pack_tree,
+        unpack_tree,
+    )
 
     step = _make_sgd_step(config, lr, momentum)
 
     def fed_step(wire_bundle, x, y):
-        params, state = cast_floats(wire_bundle, jnp.float32)
+        packed = isinstance(wire_bundle, PackedTree)
+        params, state = (
+            unpack_tree(wire_bundle, jnp.float32)
+            if packed
+            else cast_floats(wire_bundle, jnp.float32)
+        )
         opt = init_opt_state(params)
         loss = jnp.zeros((), jnp.float32)
         for _ in range(local_steps):
             params, state, opt, loss = step(params, state, opt, x, y)
-        return cast_floats((params, state), wire_dtype), loss
+        out = (
+            pack_tree((params, state), wire_dtype)
+            if packed
+            else cast_floats((params, state), wire_dtype)
+        )
+        return out, loss
 
     return jax.jit(fed_step)

@@ -39,6 +39,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from rayfed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -615,7 +617,7 @@ def make_ring_attention(
                 "(a non-causal ring has no imbalance to fix)"
             )
         n_shards = mesh.shape[seq_axis]
-        sharded = jax.shard_map(
+        sharded = shard_map(
             functools.partial(
                 zigzag_ring_flash_attention,
                 axis_name=seq_axis,
@@ -654,7 +656,7 @@ def make_ring_attention(
         fn = functools.partial(
             ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
         )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

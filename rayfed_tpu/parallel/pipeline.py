@@ -24,6 +24,8 @@ import functools
 from typing import Any, Callable
 
 import jax
+
+from rayfed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -94,7 +96,7 @@ def make_pipeline(
     collective = functools.partial(
         pipeline_collective, stage_fn=stage_fn, axis_name=axis_name
     )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         collective,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
@@ -457,7 +459,7 @@ def make_pipeline_train(
             axis_name=axis_name,
             num_chunks=v,
         )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         collective,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),

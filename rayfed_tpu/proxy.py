@@ -34,6 +34,35 @@ def send_on_runtime(
     return result_ref
 
 
+def send_many_on_runtime(
+    runtime: Runtime,
+    dest_parties,
+    data: Any,
+    upstream_seq_id: Any,
+    downstream_seq_id: Any,
+) -> dict:
+    """Broadcast fan-out: ONE payload encode shared by every destination.
+
+    The transport encodes (and checksums, and device→host fetches) the
+    value once and pushes it to all parties concurrently — the owner's
+    broadcast-on-get cost becomes max(per-peer wire time), not
+    N × (encode + wire).  Each per-party result ref registers with the
+    cleanup watchdog exactly like a single send.
+    """
+    if runtime.send_proxy is None:
+        raise RuntimeError("transport not started; call fed.init() first")
+    refs = runtime.send_proxy.send_many(
+        dest_parties=dest_parties,
+        data=data,
+        upstream_seq_id=upstream_seq_id,
+        downstream_seq_id=downstream_seq_id,
+    )
+    if runtime.cleanup_manager is not None:
+        for ref in refs.values():
+            runtime.cleanup_manager.push_to_sending(ref)
+    return refs
+
+
 def recv_on_runtime(
     runtime: Runtime,
     src_party: str,

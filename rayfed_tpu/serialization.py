@@ -22,11 +22,32 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-# Internal types the wire codec itself needs; always admitted.
+# Internal types the wire codec itself needs; always admitted.  The
+# packed-tree wire form (fl.compression.PackedTree) rides the pickled
+# container skeleton, and its static spec carries a jax PyTreeDef —
+# whose pickle references the jaxlib PyTreeDef class and the jax
+# default registry.
 _INTERNAL_ALLOWED = {
     ("rayfed_tpu.transport.wire", "_Skeleton"),
     ("rayfed_tpu.transport.wire", "_LeafSlot"),
+    ("rayfed_tpu.fl.compression", "PackedTree"),
+    ("rayfed_tpu.fl.compression", "PackSpec"),
+    ("jax._src.tree_util", "default_registry"),
 }
+
+
+def _is_internal_allowed(module: str, name: str) -> bool:
+    if (module, name) in _INTERNAL_ALLOWED:
+        return True
+    # PyTreeDef's defining module moved across jaxlib versions
+    # (jaxlib.xla_extension.pytree → jaxlib._jax.pytree); admit the class
+    # by name from any jax-owned module rather than pinning one path.
+    # Dot-anchored so e.g. "jaxlib_evil" does not slip through.
+    if name == "PyTreeDef" and (
+        module == "jaxlib" or module.startswith(("jaxlib.", "jax."))
+    ):
+        return True
+    return False
 
 
 def _compose_whitelist(allowed: Dict[str, Any]) -> tuple[set, set]:
@@ -53,7 +74,7 @@ class RestrictedUnpickler(pickle.Unpickler):
         self._exact, self._wildcard = _compose_whitelist(allowed)
 
     def find_class(self, module: str, name: str):
-        if (module, name) in _INTERNAL_ALLOWED:
+        if _is_internal_allowed(module, name):
             return super().find_class(module, name)
         if (module, name) in self._exact:
             return super().find_class(module, name)

@@ -24,12 +24,21 @@ import json
 import logging
 import ssl
 import struct
+import time
 from typing import Any, Dict, List, Optional
 
 from rayfed_tpu.config import RetryPolicy
 from rayfed_tpu.transport import wire
 
 logger = logging.getLogger(__name__)
+
+# Streamed payload bytes are cut into chunks of this size on the write
+# path: the CRC of chunk k+1 (and the device→host fetch of the next
+# lazy shard) runs in an executor thread while chunk k's writev blocks
+# in another — the socket never waits on checksum/encode work and vice
+# versa.  4 MB rides well above syscall overhead while keeping ~2 chunks
+# of lookahead memory.
+WRITE_CHUNK_BYTES = 4 * 1024 * 1024
 
 
 class SendError(ConnectionError):
@@ -113,6 +122,17 @@ class TransportClient:
         self._ctl_conn: Optional[_Conn] = None
         self._ctl_lock = asyncio.Lock()
         self._closed = False
+        # Send-pipeline accounting (loop-thread only): wall time of
+        # payload frames vs the executor time spent preparing bytes
+        # (device→host fetch + checksum) and writing them.  prepare +
+        # write > wall means the chunk pipeline overlapped them.
+        self.stats: Dict[str, Any] = {
+            "send_frames": 0,
+            "send_payload_bytes": 0,
+            "send_prepare_s": 0.0,
+            "send_write_s": 0.0,
+            "send_frame_wall_s": 0.0,
+        }
 
     # -- connection management ------------------------------------------------
 
@@ -217,10 +237,16 @@ class TransportClient:
         conn.fd = None
 
     async def close(self) -> None:
-        self._closed = True
-        if self._ctl_conn is not None:
-            self._conns.append(self._ctl_conn)  # close with the rest
-            self._ctl_conn = None
+        # Under _ctl_lock: a concurrent ping past the _closed check in
+        # _acquire_ctl_conn may be mid-_open_conn — waiting for the lock
+        # here means either we see its fresh connection (and drain it
+        # below) or it sees _closed and never opens one.  Setting _closed
+        # without the lock leaked exactly that socket + reader task.
+        async with self._ctl_lock:
+            self._closed = True
+            if self._ctl_conn is not None:
+                self._conns.append(self._ctl_conn)  # close with the rest
+                self._ctl_conn = None
         for conn in list(self._conns):
             if conn.reader_task is not None:
                 conn.reader_task.cancel()
@@ -319,18 +345,16 @@ class TransportClient:
 
         Native path (non-TLS, C++ built): bytes go straight to the kernel
         via ``writev`` in an executor thread — the event loop never
-        copies or blocks, and lazy shards overlap their device→host fetch
-        with the previous chunk's socket write.  Fallback: asyncio writer.
+        copies or blocks.  The payload is cut into
+        :data:`WRITE_CHUNK_BYTES` chunks and fully pipelined: the
+        device→host fetch of lazy shard k+1 AND the checksum of chunk
+        k+1 run in executor threads while chunk k's writev blocks in
+        another, so a large payload's encode/compress cost hides under
+        the wire instead of serializing in front of it.  Fallback:
+        asyncio writer (same pipeline, SSL owns the socket).
         """
         if crc_trailer:
             from rayfed_tpu import native
-
-        def _materialize(buf, seed):
-            host = buf.produce() if isinstance(buf, wire.LazyBuffer) else buf
-            # Fetch + checksum in ONE executor hop; the chained seed makes
-            # the trailer equal crc32c(concat(payload)).
-            crc = native.crc32c(host, seed) if crc_trailer else 0
-            return host, crc
 
         use_fd = conn.fd is not None
         if use_fd:
@@ -352,34 +376,80 @@ class TransportClient:
                         f"write to {self._dest_party} stalled: {e}"
                     ) from e
 
-        if not payload_bufs:
+        write_s = 0.0
+
+        async def _write(bufs: List) -> None:
+            nonlocal write_s
+            t0 = time.perf_counter()
             if use_fd:
-                await loop.run_in_executor(None, _writev, frame_bufs)
+                await loop.run_in_executor(None, _writev, bufs)
             else:
-                for buf in frame_bufs:
+                for buf in bufs:
                     conn.writer.write(buf)
                 await conn.writer.drain()
+            write_s += time.perf_counter() - t0
+
+        if not payload_bufs:
+            await _write(frame_bufs)
             return
 
+        def _produce(buf):
+            """Executor hop: materialize one payload buffer as a byte view."""
+            t0 = time.perf_counter()
+            host = buf.produce() if isinstance(buf, wire.LazyBuffer) else buf
+            mv = host if isinstance(host, memoryview) else memoryview(host)
+            if mv.format != "B":
+                mv = mv.cast("B")
+            return mv, time.perf_counter() - t0
+
+        def _crc(view, seed):
+            t0 = time.perf_counter()
+            # Chained seed: the trailer equals crc32c(concat(payload)).
+            return native.crc32c(view, seed), time.perf_counter() - t0
+
+        t_frame0 = time.perf_counter()
+        prepare_s = 0.0
+        payload_nbytes = 0
         crc = 0
         head: List = list(frame_bufs)  # rides along with the first chunk
-        prefetch = loop.run_in_executor(None, _materialize, payload_bufs[0], 0)
+        prefetch = loop.run_in_executor(None, _produce, payload_bufs[0])
         for i in range(len(payload_bufs)):
-            host, crc = await prefetch
+            mv, dt = await prefetch
+            prepare_s += dt
+            payload_nbytes += mv.nbytes
             if i + 1 < len(payload_bufs):
                 prefetch = loop.run_in_executor(
-                    None, _materialize, payload_bufs[i + 1], crc
+                    None, _produce, payload_bufs[i + 1]
                 )
-            chunk = head + [host]
-            head = []
-            if i == len(payload_bufs) - 1 and crc_trailer:
-                chunk.append(struct.pack(">I", crc))
-            if use_fd:
-                await loop.run_in_executor(None, _writev, chunk)
-            else:
-                for buf in chunk:
-                    conn.writer.write(buf)
-                await conn.writer.drain()
+            nchunks = max(1, -(-mv.nbytes // WRITE_CHUNK_BYTES))
+            views = [
+                mv[j * WRITE_CHUNK_BYTES : (j + 1) * WRITE_CHUNK_BYTES]
+                for j in range(nchunks)
+            ]
+            crc_fut = (
+                loop.run_in_executor(None, _crc, views[0], crc)
+                if crc_trailer
+                else None
+            )
+            last_buf = i == len(payload_bufs) - 1
+            for j, view in enumerate(views):
+                if crc_trailer:
+                    crc, dt = await crc_fut
+                    prepare_s += dt
+                    if j + 1 < len(views):
+                        crc_fut = loop.run_in_executor(
+                            None, _crc, views[j + 1], crc
+                        )
+                chunk = head + [view]
+                head = []
+                if last_buf and j == len(views) - 1 and crc_trailer:
+                    chunk.append(struct.pack(">I", crc))
+                await _write(chunk)
+        self.stats["send_frames"] += 1
+        self.stats["send_payload_bytes"] += payload_nbytes
+        self.stats["send_prepare_s"] += prepare_s
+        self.stats["send_write_s"] += write_s
+        self.stats["send_frame_wall_s"] += time.perf_counter() - t_frame0
 
     @property
     def checksum_enabled(self) -> bool:
@@ -419,11 +489,14 @@ class TransportClient:
         if error is not None:
             header["err"] = error
         has_lazy = any(isinstance(b, wire.LazyBuffer) for b in payload_bufs)
+        streamed = has_lazy or payload_len >= wire.SHARD_STREAM_THRESHOLD
         crc_trailer = False
-        if has_lazy:
-            # Streamed payload: the checksum chains incrementally during
-            # the write and rides a trailer, not the header.
-            crc_trailer = self._checksum
+        if crc is None and self._checksum and streamed:
+            # Streamed payload (lazy shards, or big enough to chunk):
+            # the checksum chains incrementally during the write —
+            # overlapped with the socket, per chunk — and rides a
+            # trailer, not the header.
+            crc_trailer = True
         elif crc is None and self._checksum:
             # Prefer passing ``crc`` precomputed off-loop (the manager's
             # codec pool does) — this inline path serves direct callers.

@@ -20,7 +20,7 @@ import concurrent.futures
 import logging
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
 from rayfed_tpu.executor import LocalRef
@@ -144,6 +144,14 @@ class TransportManager:
         # start minutes apart, and a not-up-yet peer must park recvs
         # (bounded by the backstop), not get declared dead.
         ever_reachable: set = set()
+        # Previous cycle's per-party received-byte counters (including
+        # bytes of payloads still mid-read): a counter that moved since
+        # the last cycle is proof of life even when control pings queue
+        # behind the bulk transfer and time out — a multi-GB push must
+        # not get its sender declared dead mid-transfer (the parked
+        # recvs would be failed AND their keys marked consumed, so the
+        # transfer's eventual completion would be dropped as a dup).
+        rx_prev: Dict[str, int] = {}
 
         async def probe(party: str) -> bool:
             try:
@@ -172,10 +180,14 @@ class TransportManager:
             # Concurrent probes: one unreachable party must not delay
             # (and thereby slow detection for) the others.
             results = await asyncio.gather(*(probe(p) for p in parties))
+            rx_now = self._server.receive_progress()
             for party, ok in zip(parties, results):
-                # A fresh delivery is liveness regardless of the ping: a
-                # party mid-bulk-transfer can be slow to answer control
-                # frames, but its arriving data proves it isn't dead.
+                # Fresh arriving bytes are liveness regardless of the
+                # ping: a party mid-bulk-transfer can be slow to answer
+                # control frames, but its data actively landing (even
+                # partially, mid-payload) proves it isn't dead.
+                if not ok and rx_now.get(party, 0) != rx_prev.get(party, 0):
+                    ok = True
                 if not ok and self._mailbox.seconds_since_delivery(
                     party
                 ) <= interval:
@@ -209,6 +221,7 @@ class TransportManager:
                             f"its pending sends will never arrive",
                         ).to_wire()
                         self._mailbox.fail_party(party, err)
+            rx_prev = rx_now
 
     def stop(self) -> None:
         async def _shutdown():
@@ -355,66 +368,113 @@ class TransportManager:
         failed producer task or encode also poisons the promised key on
         the consumer (see :meth:`_send_poison`).
         """
-        out_ref = LocalRef()
-        self.stats["send_op_count"] += 1
+        return self.send_many(
+            [dest_party], data, upstream_seq_id, downstream_seq_id
+        )[dest_party]
+
+    def send_many(
+        self,
+        dest_parties: Sequence[str],
+        data: Any,
+        upstream_seq_id: Any,
+        downstream_seq_id: Any,
+    ) -> Dict[str, LocalRef]:
+        """Fan one value out to N parties — encode once, send concurrently.
+
+        The broadcast-on-get path used to encode (and device→host fetch,
+        and checksum) the same value once PER destination; here the
+        payload buffers are built once, lazy shards are wrapped so the
+        device fetch runs once (:func:`wire.share_buffers`), and the N
+        ``send_data`` coroutines run concurrently on the loop — each
+        connection's writev in its own executor thread, so fan-out wall
+        time approaches max(per-dest wire time) instead of the sum.
+
+        Returns ``{party: LocalRef→bool}`` (one result per destination,
+        same swallow-to-False semantics as :meth:`send`).
+        """
+        dests = list(dest_parties)
+        out_refs: Dict[str, LocalRef] = {p: LocalRef() for p in dests}
+        self.stats["send_op_count"] += len(dests)
+
+        def _poison_all(exc: BaseException) -> None:
+            for p in dests:
+                poison_ref = self._send_poison(
+                    p, upstream_seq_id, downstream_seq_id, exc
+                )
+                # False only after the poison delivery settles —
+                # otherwise shutdown's task-cancel races the in-flight
+                # poison send.
+                poison_ref.add_done_callback(
+                    lambda _ref, p=p: out_refs[p].set_result(False)
+                )
 
         def _encode_and_send(value: Any) -> None:
             try:
                 bufs = wire.encode_payload(value, lazy_shards=True)
+                if len(dests) > 1:
+                    bufs = wire.share_buffers(bufs)
                 nbytes = wire.payload_nbytes(bufs)
-                t0 = time.perf_counter()
-                client = self._get_client(dest_party)
-                crc = None
                 streaming = any(
                     isinstance(b, wire.LazyBuffer) for b in bufs
-                )
-                if client.checksum_enabled and not streaming:
-                    # Checksum on the codec thread, not the event loop.
-                    # (Streamed payloads checksum incrementally during
-                    # the write — see TransportClient._write_payload.)
+                ) or nbytes >= wire.SHARD_STREAM_THRESHOLD
+                crc = None
+                if not streaming and self._get_client(
+                    dests[0]
+                ).checksum_enabled:
+                    # Small payloads: checksum once on the codec thread,
+                    # shared by every destination.  Streamed payloads
+                    # chain their CRC per chunk overlapped with the
+                    # socket write (TransportClient._write_frame).
                     from rayfed_tpu import native
 
                     crc = native.crc32c_multi(bufs)
-                cf = asyncio.run_coroutine_threadsafe(
-                    client.send_data(bufs, str(upstream_seq_id),
-                                     str(downstream_seq_id), crc=crc),
-                    self._loop,
-                )
+            except Exception as e:
+                logger.warning("[%s] failed to encode payload for %s: %r",
+                               self._party, dests, e)
+                _poison_all(e)
+                return
 
-                def _done(f):
+            t0 = time.perf_counter()
+            for p in dests:
+                try:
+                    client = self._get_client(p)
+                    cf = asyncio.run_coroutine_threadsafe(
+                        client.send_data(bufs, str(upstream_seq_id),
+                                         str(downstream_seq_id), crc=crc),
+                        self._loop,
+                    )
+                except Exception as e:  # pragma: no cover - construction
+                    logger.warning(
+                        "[%s] cannot send to %s (up=%s down=%s): %r",
+                        self._party, p, upstream_seq_id, downstream_seq_id,
+                        e,
+                    )
+                    out_refs[p].set_result(False)
+                    continue
+
+                def _done(f, p=p):
                     try:
                         f.result()
-                        self._peers_acked.add(dest_party)
+                        self._peers_acked.add(p)
                         dt = time.perf_counter() - t0
                         self.stats["send_bytes"] += nbytes
                         self.stats["send_seconds"] += dt
                         from rayfed_tpu import metrics
 
                         metrics.get_transfer_log().record(
-                            "send", dest_party, upstream_seq_id,
+                            "send", p, upstream_seq_id,
                             downstream_seq_id, nbytes, dt,
                         )
-                        out_ref.set_result(True)
+                        out_refs[p].set_result(True)
                     except Exception as e:
                         logger.warning(
                             "[%s] failed to send to %s (up=%s down=%s): %r",
-                            self._party, dest_party, upstream_seq_id,
+                            self._party, p, upstream_seq_id,
                             downstream_seq_id, e,
                         )
-                        out_ref.set_result(False)
+                        out_refs[p].set_result(False)
 
                 cf.add_done_callback(_done)
-            except Exception as e:
-                logger.warning("[%s] failed to encode payload for %s: %r",
-                               self._party, dest_party, e)
-                poison_ref = self._send_poison(
-                    dest_party, upstream_seq_id, downstream_seq_id, e
-                )
-                # False only after the poison delivery settles — otherwise
-                # shutdown's task-cancel races the in-flight poison send.
-                poison_ref.add_done_callback(
-                    lambda _ref: out_ref.set_result(False)
-                )
 
         if isinstance(data, LocalRef):
             def _on_data(ref: LocalRef) -> None:
@@ -422,21 +482,16 @@ class TransportManager:
                 if exc is not None:
                     logger.warning(
                         "[%s] upstream task failed; cannot send to %s: %r",
-                        self._party, dest_party, exc,
+                        self._party, dests, exc,
                     )
-                    poison_ref = self._send_poison(
-                        dest_party, upstream_seq_id, downstream_seq_id, exc
-                    )
-                    poison_ref.add_done_callback(
-                        lambda _ref: out_ref.set_result(False)
-                    )
+                    _poison_all(exc)
                     return
                 self._codec_pool.submit(_encode_and_send, ref.resolve())
 
             data.add_done_callback(_on_data)
         else:
             self._codec_pool.submit(_encode_and_send, data)
-        return out_ref
+        return out_refs
 
     # -- recv path (RecvProxy role) ------------------------------------------
 
@@ -447,7 +502,6 @@ class TransportManager:
         downstream_seq_id: Any,
     ) -> LocalRef:
         """Park until the owner's push lands; resolves to the decoded value."""
-        out_ref = LocalRef()
         allowed = self._cluster.serializing_allowed_list
         device_put = self._job.device_put_received
 
@@ -465,46 +519,34 @@ class TransportManager:
             self._loop,
         )
 
-        def _on_message(f) -> None:
-            try:
-                message: Message = f.result()
-            except Exception as e:
-                out_ref.set_exception(e)
-                return
-
+        def _decode(message: Message) -> Any:
             if message.error is not None:
                 from rayfed_tpu.exceptions import RemoteError
 
-                out_ref.set_exception(RemoteError.from_wire(message.error))
-                return
+                raise RemoteError.from_wire(message.error)
+            mesh = self.mesh_provider() if self.mesh_provider else None
+            value = wire.decode_payload(
+                message.payload,
+                allowed=allowed,
+                device_put=device_put,
+                mesh=mesh,
+                zero_copy=self._job.zero_copy_host_arrays,
+            )
+            from rayfed_tpu import metrics
 
-            def _decode():
-                try:
-                    mesh = self.mesh_provider() if self.mesh_provider else None
-                    value = wire.decode_payload(
-                        message.payload,
-                        allowed=allowed,
-                        device_put=device_put,
-                        mesh=mesh,
-                        zero_copy=self._job.zero_copy_host_arrays,
-                    )
-                    from rayfed_tpu import metrics
+            # Denominator = socket-read wall time (honest wire GB/s
+            # at the receiver); decode runs here but is not billed.
+            metrics.get_transfer_log().record(
+                "recv", message.src_party, upstream_seq_id,
+                downstream_seq_id, len(message.payload),
+                message.read_seconds,
+            )
+            return value
 
-                    # Denominator = socket-read wall time (honest wire GB/s
-                    # at the receiver); decode runs here but is not billed.
-                    metrics.get_transfer_log().record(
-                        "recv", message.src_party, upstream_seq_id,
-                        downstream_seq_id, len(message.payload),
-                        message.read_seconds,
-                    )
-                    out_ref.set_result(value)
-                except Exception as e:
-                    out_ref.set_exception(e)
-
-            self._codec_pool.submit(_decode)
-
-        cf.add_done_callback(_on_message)
-        return out_ref
+        # Decode on the codec pool, never the event loop; a packed tree
+        # (fl.compression.PackedTree) comes back as ONE zero-copy buffer
+        # view + skeleton here — no per-leaf intermediate copies.
+        return LocalRef(cf).then(_decode, executor=self._codec_pool)
 
     # -- readiness ------------------------------------------------------------
 
@@ -522,6 +564,22 @@ class TransportManager:
         stats.update(self._server.stats)
         stats.update(self._mailbox.stats)  # dups, expiries, peer fails
         stats["pending_recvs"] = self._mailbox.pending_count()
+        # Send-pipeline decomposition summed over per-peer clients:
+        # prepare (device→host fetch + checksum) + write > frame wall
+        # means the chunk pipeline overlapped them; the saved seconds
+        # are the overlap win vs a serialized encode→checksum→write.
+        with self._clients_lock:
+            clients = list(self._clients.values())
+        for key in (
+            "send_frames", "send_payload_bytes", "send_prepare_s",
+            "send_write_s", "send_frame_wall_s",
+        ):
+            stats[key] = sum(c.stats[key] for c in clients)
+        stats["send_overlap_saved_s"] = max(
+            0.0,
+            stats["send_prepare_s"] + stats["send_write_s"]
+            - stats["send_frame_wall_s"],
+        )
         # Snapshot, not the live dict: get_stats runs on user threads
         # while the loop-thread health monitor mutates the dead set.
         stats["dead_parties"] = sorted(self._mailbox.dead_parties_snapshot())

@@ -86,6 +86,12 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         return self._small_view[self._got : self._need]
 
     def buffer_updated(self, nbytes: int) -> None:
+        if self._state == "payload" and nbytes:
+            # Mid-payload liveness: the health monitor counts bytes
+            # actively arriving from a party as proof of life, so a
+            # multi-GB push can't get its sender declared dead just
+            # because control pings queue behind the bulk transfer.
+            self._server.note_rx_progress(self._header.get("src"), nbytes)
         self._got += nbytes
         if self._got < self._need:
             return
@@ -201,6 +207,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         poller = select.poll()
         poller.register(fd, select.POLLIN)
         view = self._payload_view
+        src = self._header.get("src")
         got = 0
         while got < len(view):
             try:
@@ -208,6 +215,9 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 if r == 0:
                     raise ConnectionError("peer closed mid-payload")
                 got += r
+                # Same liveness signal as the protocol path (note_rx_
+                # progress tolerates this executor-thread caller).
+                self._server.note_rx_progress(src, r)
                 deadline = time.monotonic() + idle_limit
             except (BlockingIOError, InterruptedError):
                 remaining = deadline - time.monotonic()
@@ -418,6 +428,21 @@ class TransportServer:
         self._on_message = on_message
         self._warned_no_native_crc = False
         self.stats: Dict[str, Any] = {"receive_op_count": 0, "receive_bytes": 0}
+        # Per-party monotonically growing byte counters INCLUDING bytes
+        # of payloads still in flight (the completed-put counters above
+        # only move at frame boundaries).  Written from the loop thread
+        # and the raw-read executor threads: plain dict ops are atomic
+        # under the GIL, and a (rare) lost += only delays the health
+        # monitor's liveness credit by one ping cycle.
+        self._rx_progress: Dict[str, int] = {}
+
+    def note_rx_progress(self, party: Optional[str], nbytes: int) -> None:
+        if party:
+            self._rx_progress[party] = self._rx_progress.get(party, 0) + nbytes
+
+    def receive_progress(self) -> Dict[str, int]:
+        """Snapshot of per-party received bytes (incl. in-flight payloads)."""
+        return dict(self._rx_progress)
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()

@@ -27,6 +27,7 @@ from __future__ import annotations
 import functools
 import json
 import struct
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -44,6 +45,15 @@ MAGIC = b"RFW1"
 _HEADER_STRUCT = struct.Struct(">4sBBIQ")
 HEADER_SIZE = _HEADER_STRUCT.size
 
+# Version of the payload manifest layout.  BUMP THIS whenever the
+# manifest schema changes (new leaf kinds, renamed/removed fields,
+# different framing of the skeleton) — ``tool/check_wire_format.py``
+# (run by test.sh) fails the build when the layout fingerprint drifts
+# without a version bump.  Receivers reject payloads from a NEWER
+# format than they understand instead of misparsing them.
+# History: 1 = unversioned original; 2 = "v" field added to manifest.
+WIRE_FORMAT_VERSION = 2
+
 MSG_DATA = 1
 MSG_ACK = 2
 MSG_PING = 3
@@ -58,6 +68,14 @@ FLAG_CRC_TRAILER = 0x01
 # lazily, so the send path can overlap device→host fetch of shard k+1
 # with the socket write of shard k.
 SHARD_STREAM_THRESHOLD = 8 * 1024 * 1024
+
+# With zero_copy decode, plain "nd" leaves at or above this size come
+# back as READONLY views aliasing the payload (e.g. a packed-tree
+# buffer just under the shard-stream threshold).  Smaller leaves keep
+# the writable-copy behavior: a retained few-KB view must not pin a
+# multi-GB payload buffer alive, and in-place consumers of small
+# host leaves keep working.
+ND_ZERO_COPY_MIN_BYTES = 1 * 1024 * 1024
 
 
 def pack_frame(
@@ -146,6 +164,37 @@ class LazyBuffer:
         return buf
 
 
+class SharedLazyBuffer(LazyBuffer):
+    """A LazyBuffer whose produce runs once and is shared by N readers.
+
+    Fan-out sends push the SAME payload to several parties; without
+    sharing, each destination's write path would repeat the device→host
+    fetch.  The cached view lives until the last send drops the buffer
+    list.
+    """
+
+    __slots__ = ("_lock", "_cached")
+
+    def __init__(self, inner: LazyBuffer) -> None:
+        super().__init__(inner._produce, inner.nbytes)
+        self._lock = threading.Lock()
+        self._cached: Optional[memoryview] = None
+
+    def produce(self) -> memoryview:
+        with self._lock:
+            if self._cached is None:
+                self._cached = super().produce()
+            return self._cached
+
+
+def share_buffers(buffers: List) -> List:
+    """Wrap every LazyBuffer for one-fetch fan-out (see SharedLazyBuffer)."""
+    return [
+        SharedLazyBuffer(b) if isinstance(b, LazyBuffer) else b
+        for b in buffers
+    ]
+
+
 def _shard_host_view(shard) -> memoryview:
     host = np.asarray(shard.data)
     if not host.flags["C_CONTIGUOUS"]:
@@ -191,8 +240,11 @@ def resolve_sharding(desc: Optional[Dict[str, Any]], mesh) -> Optional[Any]:
             return None
     from jax.sharding import NamedSharding, PartitionSpec
 
+    # Singleton axis lists unwrap to the bare name: PartitionSpec('dp')
+    # and PartitionSpec(('dp',)) are equivalent but only compare equal on
+    # newer jax — emit the canonical form.
     spec = PartitionSpec(
-        *(tuple(e) if e else None for e in desc["spec"])
+        *((tuple(e) if len(e) > 1 else e[0]) if e else None for e in desc["spec"])
     )
     return NamedSharding(mesh, spec)
 
@@ -321,7 +373,11 @@ def encode_payload(obj: Any, lazy_shards: bool = False) -> List:
     )
     skeleton_blob = serialization.dumps(_Skeleton(skeleton))
     manifest = json.dumps(
-        {"leaves": manifest_leaves, "skel": len(skeleton_blob)},
+        {
+            "v": WIRE_FORMAT_VERSION,
+            "leaves": manifest_leaves,
+            "skel": len(skeleton_blob),
+        },
         separators=(",", ":"),
     ).encode()
     out: List = [struct.pack(">I", len(manifest)), manifest, skeleton_blob]
@@ -410,16 +466,25 @@ def decode_payload(
     ``mesh``: the receiver's party mesh — shard-encoded leaves whose
     sender sharding fits it are device_put with the equivalent local
     NamedSharding (per-shard placement instead of replication).
-    ``zero_copy``: without device_put, shard-streamed leaves whose wire
-    layout is already C-order decode as READONLY views aliasing the
-    payload (no assembly copy) — opt-in because in-place consumers need
-    writable arrays.
+    ``zero_copy``: without device_put, large array leaves decode as
+    READONLY views aliasing the payload — plain ``nd`` leaves at or
+    above :data:`ND_ZERO_COPY_MIN_BYTES`, and shard-streamed leaves
+    whose wire layout is already C-order (no assembly copy) — opt-in
+    because in-place consumers need writable arrays; small leaves stay
+    writable copies so a retained view can't pin a huge payload.
     """
     mv = memoryview(payload)
     (mlen,) = struct.unpack(">I", mv[:4])
     offset = 4
     manifest = json.loads(bytes(mv[offset : offset + mlen]))
     offset += mlen
+    fmt_version = manifest.get("v", 1)
+    if fmt_version > WIRE_FORMAT_VERSION:
+        raise ValueError(
+            f"payload uses wire format v{fmt_version}; this receiver "
+            f"understands up to v{WIRE_FORMAT_VERSION} — upgrade the "
+            f"receiving party"
+        )
     skel_len = manifest["skel"]
     skeleton_obj = serialization.loads(bytes(mv[offset : offset + skel_len]), allowed)
     offset += skel_len
@@ -431,14 +496,29 @@ def decode_payload(
         kind = spec["k"]
         if kind == "nd":
             n = spec["n"]
-            arr = np.frombuffer(mv[offset : offset + n], dtype=np.dtype(spec["dtype"]))
+            as_view = (
+                zero_copy
+                and n >= ND_ZERO_COPY_MIN_BYTES
+                and not (spec.get("dev") and device_put)
+            )
+            if as_view:
+                # Zero-copy opt-in, large leaves only: READONLY view
+                # aliasing the payload (same contract as the "nds" path
+                # below) — e.g. a packed-tree buffer below the
+                # shard-stream threshold decodes with no memcpy at all.
+                region = mv[offset : offset + n].toreadonly()
+                arr = np.frombuffer(region, dtype=np.dtype(spec["dtype"]))
+            else:
+                arr = np.frombuffer(
+                    mv[offset : offset + n], dtype=np.dtype(spec["dtype"])
+                )
             arr = arr.reshape(spec["shape"])
             offset += n
             if spec.get("dev") and device_put:
                 # Zero-copy path: device_put copies host→HBM directly from
                 # the received buffer; no intermediate host materialization.
                 arr = jax.device_put(arr, device) if device is not None else jax.device_put(arr)
-            else:
+            elif not as_view:
                 # Host-array leaves must be writable (reference's pickle
                 # path returned writable arrays) and must not pin the whole
                 # payload buffer alive — one copy, same cost as pickle.

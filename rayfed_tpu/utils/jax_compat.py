@@ -1,0 +1,50 @@
+"""Version-portability shims for jax APIs that moved between releases.
+
+The compute layer targets current jax (``jax.shard_map``,
+``jax.sharding.set_mesh``, ``check_vma``), but deployment containers pin
+older jaxlib builds where those names live under ``jax.experimental`` or
+don't exist.  These wrappers keep ONE call-site spelling and translate:
+
+- :func:`shard_map` — ``jax.shard_map`` when present, else
+  ``jax.experimental.shard_map.shard_map`` with ``check_vma`` mapped to
+  its older ``check_rep`` spelling.
+- :func:`set_mesh` — ``jax.sharding.set_mesh`` when present, else the
+  classic ``with mesh:`` context (the implicit-mesh mechanism those
+  releases used).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+if not _HAS_NEW_SHARD_MAP:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` across jax versions (``check_vma``⇄``check_rep``)."""
+    if _HAS_NEW_SHARD_MAP:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _legacy_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+@contextlib.contextmanager
+def set_mesh(mesh):
+    """``jax.sharding.set_mesh`` across jax versions."""
+    if hasattr(jax.sharding, "set_mesh"):
+        with jax.sharding.set_mesh(mesh):
+            yield mesh
+    else:  # pragma: no cover - version-dependent
+        with mesh:
+            yield mesh

@@ -21,13 +21,23 @@ def force_cpu_devices(n: int = 8) -> None:
     ``jax.devices()`` / jit execution) in the calling process.
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
+    # REPLACE any inherited device-count flag rather than keeping it: a
+    # child asking for 4 devices must not silently run with the parent's
+    # 8 (on older jax this flag is the only mechanism — see below).
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # Older jax: the option doesn't exist — the XLA_FLAGS override
+        # above (set before the first backend init) provides the mesh.
+        pass

@@ -14,6 +14,11 @@ else
   echo "ruff not installed; skipping lint"
 fi
 
+# Codec-format drift gate: the wire manifest layout is a cross-party
+# contract — this fails unless WIRE_FORMAT_VERSION was bumped (and the
+# lock re-pinned) whenever the layout changes.
+JAX_PLATFORMS=cpu python tool/check_wire_format.py
+
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 
 echo "All tests finished."

@@ -1,0 +1,280 @@
+"""Packed-tree wire codec: round-trip parity with the per-leaf path.
+
+The packed form must be a pure representation change — bit-exact bf16
+payloads, identical structures/dtypes after decompress — across mixed
+dtypes, non-float leaves, nesting, sharded arrays, and the real wire
+codec (including the restricted-unpickle skeleton path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.fl.compression import (
+    PackedTree,
+    cast_floats,
+    compress,
+    decompress,
+    pack_tree,
+    unpack_tree,
+)
+from rayfed_tpu.transport import wire
+
+
+def _mixed_tree():
+    return {
+        "w": jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4),
+        "nested": {
+            "bf16": jnp.full((5,), 1.5, jnp.bfloat16),
+            "ints": np.arange(6, dtype=np.int32).reshape(2, 3),
+            "scalar": jnp.float32(2.25),
+        },
+        "list": [jnp.zeros(()), np.float32(7.0), "a string", None],
+        "flag": True,
+        "count": 11,
+    }
+
+
+def _assert_tree_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        if hasattr(x, "dtype") or hasattr(y, "dtype"):
+            assert np.dtype(x.dtype) == np.dtype(y.dtype)
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            assert x == y
+
+
+def test_roundtrip_matches_per_leaf_path():
+    tree = _mixed_tree()
+    packed_back = decompress(compress(tree, packed=True), jnp.float32)
+    per_leaf_back = decompress(compress(tree), jnp.float32)
+    _assert_tree_equal(packed_back, per_leaf_back)
+
+
+def test_bf16_payload_bit_exact_parity():
+    """The packed buffer holds the SAME bf16 bits the per-leaf cast makes."""
+    tree = _mixed_tree()
+    packed = compress(tree, packed=True)
+    per_leaf = compress(tree)
+    wire_views = unpack_tree(packed)  # no cast: views of the buffer
+    for v, ref in zip(
+        jax.tree_util.tree_leaves(wire_views),
+        jax.tree_util.tree_leaves(per_leaf),
+    ):
+        if hasattr(ref, "dtype") and jnp.issubdtype(ref.dtype, jnp.floating):
+            np.testing.assert_array_equal(
+                np.asarray(v).view(np.uint16).reshape(-1),
+                np.asarray(ref).view(np.uint16).reshape(-1),
+            )
+
+
+def test_unpack_without_cast_is_zero_copy():
+    tree = {"a": np.ones((8, 8), np.float32), "b": np.arange(4)}
+    packed = pack_tree(tree, np.float32)
+    views = unpack_tree(packed)
+    assert np.shares_memory(views["a"], packed.buf)
+    # Int leaf passes through untouched (same object).
+    assert views["b"] is tree["b"]
+
+
+def test_single_cast_allocation_on_decode():
+    """f32 decode leaves view ONE allocation, not per-leaf copies."""
+    tree = {"a": np.ones(16, np.float32), "b": np.full(8, 2.0, np.float32)}
+    packed = pack_tree(tree)
+    out = unpack_tree(packed, np.float32)
+    assert out["a"].base is not None and out["a"].base is out["b"].base
+
+
+def test_traced_pack_unpack_inside_jit():
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "n": jnp.arange(3)}
+
+    @jax.jit
+    def step(pt):
+        t = unpack_tree(pt, jnp.float32)
+        t["w"] = t["w"] * 3.0
+        return pack_tree(t, jnp.bfloat16)
+
+    out = step(pack_tree(tree))
+    assert isinstance(out, PackedTree)
+    res = unpack_tree(out, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(res["w"]), np.arange(6.0).reshape(2, 3) * 3.0
+    )
+    np.testing.assert_array_equal(np.asarray(res["n"]), np.arange(3))
+
+
+def test_tree_average_over_packed_trees():
+    from rayfed_tpu.fl import tree_average
+
+    t1 = pack_tree({"w": jnp.full((4,), 1.0), "c": jnp.arange(2)})
+    t2 = pack_tree({"w": jnp.full((4,), 3.0), "c": jnp.arange(2)})
+    avg = tree_average([t1, t2])
+    assert isinstance(avg, PackedTree)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_tree(avg, jnp.float32)["w"]), np.full((4,), 2.0)
+    )
+
+
+def test_empty_float_set():
+    tree = {"i": np.arange(3), "s": "x"}
+    back = unpack_tree(pack_tree(tree), np.float32)
+    np.testing.assert_array_equal(back["i"], np.arange(3))
+    assert back["s"] == "x"
+
+
+def _wire_roundtrip(obj, **decode_kw):
+    bufs = wire.encode_payload(obj, lazy_shards=True)
+    payload = b"".join(
+        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
+        for b in bufs
+    )
+    return wire.decode_payload(payload, **decode_kw)
+
+
+def test_packed_tree_through_wire_codec():
+    tree = _mixed_tree()
+    packed = compress(tree, packed=True)
+    out = _wire_roundtrip(packed)
+    assert isinstance(out, PackedTree)
+    _assert_tree_equal(
+        decompress(out, jnp.float32), decompress(packed, jnp.float32)
+    )
+
+
+def test_packed_tree_wire_restricted_allowlist():
+    """The PackedTree skeleton (incl. its PyTreeDef) survives the
+    restricted unpickler without widening the user allowlist."""
+    packed = compress({"w": jnp.ones((4, 4))}, packed=True)
+    out = _wire_roundtrip(packed, allowed={"numpy": "*"})
+    assert isinstance(out, PackedTree)
+
+
+def test_packed_buffer_is_single_wire_leaf():
+    """60 float leaves → ONE array buffer on the wire (plus skeleton)."""
+    tree = {f"l{i}": jnp.ones((4, 4)) for i in range(60)}
+    packed = compress(tree, packed=True)
+    bufs = wire.encode_payload(packed)
+    # prefix, manifest, skeleton, packed buffer = 4 buffers total.
+    assert len(bufs) == 4
+
+
+def test_large_packed_tree_streams_lazy_shards():
+    n = wire.SHARD_STREAM_THRESHOLD // 2 + 4096  # bf16 buffer > threshold
+    tree = {"a": jnp.ones((n,)), "b": jnp.ones((8,))}
+    packed = compress(tree, packed=True)
+    bufs = wire.encode_payload(packed, lazy_shards=True)
+    assert any(isinstance(b, wire.LazyBuffer) for b in bufs)
+    out = _wire_roundtrip(packed)
+    _assert_tree_equal(
+        decompress(out, jnp.float32), decompress(packed, jnp.float32)
+    )
+
+
+def test_sharded_leaves_pack_and_roundtrip():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    x = jnp.arange(1 << 20, dtype=jnp.float32).reshape(1024, 1024)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    packed = pack_tree({"w": xs}, jnp.float32)  # f32 wire: exact values
+    out = _wire_roundtrip(packed)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_tree(out)["w"]),
+        np.asarray(x),
+    )
+
+
+def test_zero_copy_nd_decode_views_payload():
+    """zero_copy opt-in: a large sub-shard-threshold packed buffer
+    decodes as a READONLY alias of the wire payload — no memcpy."""
+    n = wire.ND_ZERO_COPY_MIN_BYTES // 2 + 1024  # bf16 buffer > 1 MB
+    packed = pack_tree({"w": np.ones((n,), np.float32)})
+    bufs = wire.encode_payload(packed)
+    payload = bytearray()
+    for b in bufs:
+        payload += bytes(b)
+    out = wire.decode_payload(payload, zero_copy=True)
+    buf = np.asarray(out.buf)
+    assert not buf.flags["WRITEABLE"]
+    assert buf.base is not None
+    # Default stays writable-owned for in-place consumers.
+    out_default = wire.decode_payload(payload)
+    assert np.asarray(out_default.buf).flags["WRITEABLE"]
+    # Small leaves stay writable copies even under zero_copy — a
+    # retained few-KB view must not pin a big payload alive.
+    small = pack_tree({"w": np.ones((64,), np.float32)})
+    spayload = b"".join(bytes(b) for b in wire.encode_payload(small))
+    sout = wire.decode_payload(spayload, zero_copy=True)
+    assert np.asarray(sout.buf).flags["WRITEABLE"]
+
+
+def test_wire_format_version_in_manifest():
+    import json
+    import struct as _struct
+
+    bufs = wire.encode_payload({"x": 1})
+    mlen = _struct.unpack(">I", bytes(bufs[0]))[0]
+    manifest = json.loads(bytes(bufs[1])[:mlen])
+    assert manifest["v"] == wire.WIRE_FORMAT_VERSION
+
+
+def test_decode_rejects_future_wire_format():
+    import json
+    import struct as _struct
+
+    bufs = wire.encode_payload({"x": 1})
+    mlen = _struct.unpack(">I", bytes(bufs[0]))[0]
+    manifest = json.loads(bytes(bufs[1])[:mlen])
+    manifest["v"] = wire.WIRE_FORMAT_VERSION + 1
+    raw = json.dumps(manifest, separators=(",", ":")).encode()
+    payload = _struct.pack(">I", len(raw)) + raw + b"".join(
+        bytes(b) for b in bufs[2:]
+    )
+    with pytest.raises(ValueError, match="wire format"):
+        wire.decode_payload(payload)
+
+
+def test_fed_train_step_packed_matches_per_leaf():
+    """A jitted fed step fed the packed bundle reproduces the per-leaf
+    bundle's numerics bit-exactly and returns the same wire form."""
+    from rayfed_tpu.models import resnet
+
+    cfg = resnet.ResNetConfig(stage_sizes=(1,), width=8, num_classes=3)
+    step = resnet.make_fed_train_step(cfg, lr=0.1)
+    tree0 = resnet.init_resnet(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 0])
+
+    out_leaf, loss_leaf = step(compress(tree0), x, y)
+    out_packed, loss_packed = step(compress(tree0, packed=True), x, y)
+    assert isinstance(out_packed, PackedTree)
+    assert float(loss_leaf) == float(loss_packed)
+    _assert_tree_equal(
+        decompress(out_leaf, jnp.float32),
+        decompress(out_packed, jnp.float32),
+    )
+
+
+def test_decompress_handles_both_forms():
+    tree = {"w": jnp.ones((3,))}
+    a = decompress(compress(tree), jnp.float32)
+    b = decompress(compress(tree, packed=True), jnp.float32)
+    _assert_tree_equal(a, b)
+    # And a full-precision tree passes through unchanged (contract for
+    # trainers that always call decompress on their argument).
+    c = decompress(tree, jnp.float32)
+    _assert_tree_equal(c, tree)
+
+
+def test_cast_floats_unchanged_semantics():
+    tree = _mixed_tree()
+    out = cast_floats(tree, jnp.bfloat16)
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(
+            leaf.dtype, jnp.floating
+        ):
+            assert leaf.dtype == jnp.bfloat16

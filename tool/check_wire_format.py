@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Fail the build when the wire-format manifest layout drifts silently.
+
+The payload manifest (``rayfed_tpu.transport.wire``) is a cross-party
+contract: two parties on different builds must agree on it byte-for-byte
+or decode misparses.  This check encodes a canonical tree covering every
+leaf kind (``nd``/``nds``/``pkl``/``py`` + the packed-tree skeleton),
+reduces the manifest to its structural schema (keys + value types, not
+values), and fingerprints it together with the frame header struct and
+the frame/flag constants.
+
+The fingerprint is pinned in ``tool/wire_format.lock`` next to
+``wire.WIRE_FORMAT_VERSION``:
+
+- layout unchanged, version unchanged      → OK
+- layout changed,  version unchanged      → FAIL: bump WIRE_FORMAT_VERSION
+- layout changed,  version bumped         → FAIL unless ``--update``
+  (re-pins the lock; commit it with the change)
+- layout unchanged, version bumped        → FAIL: gratuitous bump
+
+Run by ``test.sh``; CI-safe (read-only without ``--update``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "wire_format.lock")
+
+
+def _schema(obj):
+    """Structure of a manifest: key names + value types, values erased."""
+    if isinstance(obj, dict):
+        return {k: _schema(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, list):
+        inner = sorted({json.dumps(_schema(v), sort_keys=True) for v in obj})
+        return [json.loads(s) for s in inner]
+    if isinstance(obj, bool):
+        return "bool"
+    if isinstance(obj, int):
+        return "int"
+    if isinstance(obj, float):
+        return "float"
+    if obj is None:
+        return "null"
+    return type(obj).__name__
+
+
+def compute_fingerprint() -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rayfed_tpu.fl.compression import pack_tree
+    from rayfed_tpu.transport import wire
+
+    class _Custom:  # exercises the pickle-fallback leaf kind
+        def __init__(self):
+            self.v = 1
+
+    tree = {
+        "nd_f32": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "nd_dev": jnp.ones((4, 4)),
+        # Big enough for the shard-streamed ("nds") encoding.
+        "nds": jnp.zeros(
+            (wire.SHARD_STREAM_THRESHOLD // 4 + 16,), jnp.float32
+        ),
+        "packed": pack_tree({"w": jnp.ones((3,))}),
+        "pkl": _Custom(),
+        "py_int": 3,
+        "py_str": "s",
+        "py_none": None,
+        "py_bool": True,
+        "py_float": 1.5,
+    }
+    bufs = wire.encode_payload(tree, lazy_shards=True)
+    manifest_len = struct.unpack(">I", bytes(bufs[0]))[0]
+    manifest = json.loads(bytes(bufs[1])[:manifest_len])
+    del jax  # only imported to force backend parity with the codec
+
+    material = json.dumps(
+        {
+            "manifest_schema": _schema(manifest),
+            "leaf_kinds": sorted({e["k"] for e in manifest["leaves"]}),
+            "frame_struct": wire._HEADER_STRUCT.format,
+            "magic": wire.MAGIC.decode(),
+            "msg_types": [wire.MSG_DATA, wire.MSG_ACK, wire.MSG_PING,
+                          wire.MSG_PONG, wire.MSG_ERR],
+            "flags": [wire.FLAG_CRC_TRAILER],
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(material.encode()).hexdigest()
+
+
+def main() -> int:
+    from rayfed_tpu.transport import wire
+
+    update = "--update" in sys.argv
+    version = wire.WIRE_FORMAT_VERSION
+    fingerprint = compute_fingerprint()
+
+    if update:
+        with open(LOCK_PATH, "w") as f:
+            json.dump({"version": version, "fingerprint": fingerprint}, f,
+                      indent=2)
+            f.write("\n")
+        print(f"wire_format.lock pinned: v{version} {fingerprint[:16]}…")
+        return 0
+
+    if not os.path.exists(LOCK_PATH):
+        print(
+            f"FAIL: {LOCK_PATH} missing — run "
+            f"`python tool/check_wire_format.py --update` and commit it",
+            file=sys.stderr,
+        )
+        return 1
+    with open(LOCK_PATH) as f:
+        lock = json.load(f)
+
+    if fingerprint == lock["fingerprint"] and version == lock["version"]:
+        print(f"wire format OK: v{version} {fingerprint[:16]}…")
+        return 0
+    if fingerprint != lock["fingerprint"] and version == lock["version"]:
+        print(
+            "FAIL: wire-format manifest layout changed but "
+            f"WIRE_FORMAT_VERSION is still {version}.  Bump the constant "
+            "in rayfed_tpu/transport/wire.py, then re-pin with "
+            "`python tool/check_wire_format.py --update`.",
+            file=sys.stderr,
+        )
+        return 1
+    if fingerprint != lock["fingerprint"]:
+        print(
+            f"FAIL: wire-format layout changed (version bumped to "
+            f"{version}); re-pin with `python tool/check_wire_format.py "
+            f"--update` and commit tool/wire_format.lock.",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"FAIL: WIRE_FORMAT_VERSION bumped to {version} but the manifest "
+        f"layout is unchanged (lock has v{lock['version']}).  Revert the "
+        "bump, or re-pin if intentional.",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
