@@ -27,9 +27,11 @@ The reference (fengsp/rayfed) publishes no benchmark numbers
 (SURVEY §6); ``vs_baseline`` compares against the first recorded
 round of this framework itself (``BENCH_r*.json``), else 1.0.
 
-Usage: ``python bench.py`` (all four configs; first run needs a few
+Usage: ``python bench.py`` (all configs; first run needs a few
 minutes for compiles).  ``python bench.py --fed-only`` skips the
-accelerator configs; ``--compute-only`` skips the federated ones.
+accelerator configs; ``--compute-only`` skips the federated ones;
+``--smoke`` runs only the streaming-aggregation round bench at reduced
+scale (the CI gate test.sh drives).
 """
 
 from __future__ import annotations
@@ -474,6 +476,176 @@ def _run_push_bench(_party: str, result_q) -> None:
             "push",
             (wire_gbps, reshard_gbps, packed_gbps, perleaf_gbps,
              overlap_frac),
+        )
+    )
+
+
+def _run_stream_agg_bench(_party: str, result_q) -> None:
+    """ResNet-scale streaming FedAvg round: delta cache + on-the-wire agg.
+
+    4 parties (in-process TransportManagers over real loopback sockets,
+    like the push bench): three peers push their packed bf16 ResNet-18
+    bundles to the coordinator on per-peer **delta streams**, the
+    coordinator folds each arriving chunk into a donated on-device
+    accumulator (``fl.streaming.StreamingAggregator``) while later
+    chunks are still on the wire, then broadcasts the aggregate back on
+    a delta stream.
+
+    Update shape: each round every party updates ONE rotating quarter of
+    its parameter buffer (the head-only / adapter fine-tune shape where
+    delta caching pays — full-model SGD touches every chunk and
+    degenerates to full sends, which the cache detects and ships
+    plainly).  Consecutive rounds therefore differ in ~2 quarters
+    (revert + new), so the expected delta saving is ~50% minus chunk-
+    alignment slop.
+
+    Reports ``cross_party_stream_agg_GBps`` (logical contribution bytes
+    over the receive+aggregate phase), ``agg_overlap_frac`` (fraction of
+    aggregation busy time hidden under the wire), ``delta_bytes_saved_
+    frac`` (stream bytes the caches kept off the wire), and the round
+    latency breakdown.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+    from rayfed_tpu.transport.manager import TransportManager
+
+    smoke = bool(os.environ.get("RAYFED_BENCH_SMOKE"))
+    parties = ("alice", "bob", "carol", "dave")
+    ports = {p: 13080 + i for i, p in enumerate(parties)}
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                p: PartyConfig.from_dict({"address": f"127.0.0.1:{ports[p]}"})
+                for p in parties
+            },
+            current_party=party,
+        )
+        return TransportManager(
+            cc,
+            JobConfig(device_put_received=False, zero_copy_host_arrays=True),
+        )
+
+    mgrs = {p: mk(p) for p in parties}
+    for m in mgrs.values():
+        m.start()
+
+    if smoke:
+        # Small synthetic tree (~12 MB bf16 = 3 delta chunks) — the
+        # fast path for test.sh's bench smoke.
+        tree = {
+            f"l{i}": jnp.arange(1_500_000, dtype=jnp.float32) * 1e-6 + i
+            for i in range(4)
+        }
+        bundle = fl_comp.compress(tree, packed=True)
+        rounds = 2
+    else:
+        from rayfed_tpu.models import resnet
+
+        cfg = resnet.resnet18(num_classes=10)
+        bundle = fl_comp.compress(
+            resnet.init_resnet(jax.random.PRNGKey(0), cfg), packed=True
+        )
+        rounds = 3
+
+    base32 = np.asarray(bundle.buf).astype(np.float32)
+    n_elems = base32.size
+    bundle_bytes = np.asarray(bundle.buf).nbytes
+    wire_dt = np.asarray(bundle.buf).dtype
+
+    def contribution(party_idx: int, r: int) -> "fl_comp.PackedTree":
+        """Quarter (r % 4) perturbed, party-specific; rest byte-stable."""
+        arr = base32.copy()
+        q = n_elems // 4
+        lo = (r % 4) * q
+        arr[lo : lo + q] += 1e-3 * (party_idx + 1) * (r + 1)
+        return fl_comp.PackedTree(
+            arr.astype(wire_dt), bundle.passthrough, bundle.spec
+        )
+
+    peers = [p for p in parties if p != "alice"]
+
+    def do_round(r: int):
+        t0 = time.perf_counter()
+        contribs = {
+            p: contribution(i + 1, r) for i, p in enumerate(peers)
+        }
+        send_refs = [
+            mgrs[p].send(
+                "alice", contribs[p], f"c{r}-{p}", "0",
+                stream=f"sagg/up/{p}",
+            )
+            for p in peers
+        ]
+        agg = StreamingAggregator(len(parties))
+        for i, p in enumerate(peers):
+            mgrs["alice"].recv_stream(p, f"c{r}-{p}", "0", agg.sink(i + 1))
+        agg.add_local(0, contribution(0, r))
+        result = agg.result(timeout=300)
+        t_agg = time.perf_counter()
+        bcast_refs = mgrs["alice"].send_many(
+            peers, result, f"b{r}", "0", stream="sagg/down"
+        )
+        for p in peers:
+            out = mgrs[p].recv("alice", f"b{r}", "0").resolve(timeout=300)
+            np.asarray(out.buf[:64])  # touch: decode really happened
+        for ref in send_refs + list(bcast_refs.values()):
+            if not ref.resolve(timeout=300):
+                raise RuntimeError("stream agg bench send failed")
+        t_end = time.perf_counter()
+        return t0, t_agg, t_end, dict(agg.stats)
+
+    do_round(0)  # warmup: compiles + seeds every delta cache
+
+    def delta_totals():
+        logical = wire_b = 0
+        for m in mgrs.values():
+            st = m.get_stats()
+            logical += st["delta_logical_bytes"]
+            wire_b += st["delta_wire_bytes"]
+        return logical, wire_b
+
+    logical0, wire0 = delta_totals()
+    agg_s = bcast_s = wall_s = 0.0
+    overlaps, busys, tails, wires = [], [], [], []
+    for r in range(1, rounds + 1):
+        t0, t_agg, t_end, stats = do_round(r)
+        agg_s += t_agg - t0
+        bcast_s += t_end - t_agg
+        wall_s += t_end - t0
+        overlaps.append(stats["agg_overlap_frac"])
+        busys.append(stats["agg_busy_s"])
+        tails.append(stats["agg_tail_s"])
+        wires.append(stats["agg_wire_s"])
+    logical1, wire1 = delta_totals()
+    for m in mgrs.values():
+        m.stop()
+
+    contrib_bytes = len(peers) * bundle_bytes
+    logical = logical1 - logical0
+    shipped = wire1 - wire0
+    result_q.put(
+        (
+            "stream",
+            {
+                "gbps": contrib_bytes * rounds / agg_s / 1e9,
+                "overlap": sum(overlaps) / len(overlaps),
+                "delta_saved": (logical - shipped) / logical
+                if logical
+                else 0.0,
+                "round_ms": wall_s / rounds * 1e3,
+                "contrib_agg_ms": agg_s / rounds * 1e3,
+                "bcast_ms": bcast_s / rounds * 1e3,
+                "agg_busy_ms": sum(busys) / rounds * 1e3,
+                "agg_tail_ms": sum(tails) / rounds * 1e3,
+                "agg_wire_ms": sum(wires) / rounds * 1e3,
+                "bundle_mb": bundle_bytes / 1e6,
+            },
         )
     )
 
@@ -1794,6 +1966,27 @@ def _prior_baseline(metric: str):
     return values[0] if values else None
 
 
+def _fill_stream_extra(extra: dict, s: dict) -> None:
+    extra["cross_party_stream_agg_GBps"] = round(s["gbps"], 3)
+    extra["agg_overlap_frac"] = round(s["overlap"], 3)
+    extra["delta_bytes_saved_frac"] = round(s["delta_saved"], 3)
+    extra["stream_agg_round_ms"] = round(s["round_ms"], 1)
+    extra["stream_agg_contrib_ms"] = round(s["contrib_agg_ms"], 1)
+    extra["stream_agg_bcast_ms"] = round(s["bcast_ms"], 1)
+    extra["stream_agg_busy_ms"] = round(s["agg_busy_ms"], 1)
+    extra["stream_agg_tail_ms"] = round(s["agg_tail_ms"], 1)
+    extra["stream_agg_wire_ms"] = round(s["agg_wire_ms"], 1)
+    extra["stream_agg_bundle_mb"] = round(s["bundle_mb"], 1)
+    _log(
+        f"  stream-agg: {s['gbps']:.3f} GB/s through receive+aggregate, "
+        f"overlap {s['overlap']:.0%} of agg busy hidden under the wire, "
+        f"delta cache saved {s['delta_saved']:.0%} of stream bytes; "
+        f"round {s['round_ms']:.0f} ms = contrib+agg "
+        f"{s['contrib_agg_ms']:.0f} + bcast {s['bcast_ms']:.0f} "
+        f"(agg busy {s['agg_busy_ms']:.0f}, tail {s['agg_tail_ms']:.0f})"
+    )
+
+
 @contextlib.contextmanager
 def _section(extra: dict, name: str):
     """Isolate one benchmark section: a failure records
@@ -1812,6 +2005,30 @@ def main() -> None:
     compute_only = "--compute-only" in sys.argv
     if fed_only and compute_only:
         raise SystemExit("--fed-only and --compute-only are mutually exclusive")
+
+    if "--smoke" in sys.argv:
+        # Fast CI smoke (test.sh): ONLY the streaming-aggregation round
+        # bench at reduced scale — exercises the whole delta + streaming
+        # pipeline end-to-end over real sockets in well under a minute,
+        # and fails the build when it breaks.
+        os.environ["RAYFED_BENCH_SMOKE"] = "1"
+        extra = {}
+        with _section(extra, "stream_agg"):
+            _log("streaming-aggregation smoke (small bundles, 4 parties)...")
+            s = _one_child("_run_stream_agg_bench", ndev=1, timeout=420)
+            _fill_stream_extra(extra, s)
+        record = {
+            "metric": "cross_party_stream_agg_GBps",
+            "value": extra.get("cross_party_stream_agg_GBps", 0.0),
+            "unit": "GB/s",
+            "vs_baseline": 1.0,
+            "smoke": True,
+        }
+        record.update(extra)
+        print(json.dumps(record), flush=True)
+        if "stream_agg_error" in extra:
+            raise SystemExit(1)
+        return
 
     extra: dict = {}
     record = None
@@ -1949,6 +2166,13 @@ def main() -> None:
                     f"{extra['split_fl_vs_ceiling']} of it"
                 )
         _settle()
+
+        with _section(extra, "stream_agg"):
+            _log("streaming FedAvg aggregation (ResNet-18 packed rounds, "
+                 "delta cache, 4 parties)...")
+            s = _one_child("_run_stream_agg_bench", ndev=1, timeout=600)
+            _fill_stream_extra(extra, s)
+            _settle()
 
         with _section(extra, "lora_2party"):
             _log("2-party Llama-LoRA federated fine-tune (CPU parties)...")

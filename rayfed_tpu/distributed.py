@@ -358,19 +358,21 @@ class MultiHostTransport:
 
     # -- proxy interface ------------------------------------------------------
 
-    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id):
+    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
+             stream=None):
         if self._inner is not None:
             return self._inner.send(
                 dest_party=dest_party,
                 data=data,
                 upstream_seq_id=upstream_seq_id,
                 downstream_seq_id=downstream_seq_id,
+                stream=stream,
             )
         # Non-leader: the leader's identical program does the real push.
         return LocalRef.from_value(True)
 
     def send_many(self, dest_parties, data, upstream_seq_id,
-                  downstream_seq_id):
+                  downstream_seq_id, stream=None):
         """Fan-out broadcast (one shared encode) — leader only; see
         :meth:`TransportManager.send_many`."""
         if self._inner is not None:
@@ -379,6 +381,7 @@ class MultiHostTransport:
                 data=data,
                 upstream_seq_id=upstream_seq_id,
                 downstream_seq_id=downstream_seq_id,
+                stream=stream,
             )
         return {p: LocalRef.from_value(True) for p in dest_parties}
 
@@ -394,6 +397,27 @@ class MultiHostTransport:
             upstream_seq_id=upstream_seq_id,
             downstream_seq_id=downstream_seq_id,
         )
+
+    def recv_stream(self, src_party, upstream_seq_id, downstream_seq_id,
+                    sink):
+        """Chunk-granular receive — leader only: the cross-party wire
+        (and thus the chunk hook) exists on the leader process.  A
+        non-leader coordinator process cannot stream-aggregate; use the
+        one-shot ``fl.aggregate`` for multi-host parties until the
+        bridge republish grows a chunk hook."""
+        if self._inner is None:
+            raise NotImplementedError(
+                "streaming aggregation is not supported on non-leader "
+                "processes of a multi-host party — aggregate with "
+                "fl.aggregate there instead"
+            )
+        return self._inner.recv_stream(
+            src_party, upstream_seq_id, downstream_seq_id, sink
+        )
+
+    def cancel_stream(self, upstream_seq_id, downstream_seq_id):
+        if self._inner is not None:
+            self._inner.cancel_stream(upstream_seq_id, downstream_seq_id)
 
     def ping(self, dest_party: str, timeout_s: float = 1.0) -> bool:
         if self._inner is not None:

@@ -78,9 +78,15 @@ class LocalRef:
 
         Returns a new LocalRef resolving to ``fn(value)``; an exception
         (from this ref or from ``fn``) propagates to the returned ref.
-        With ``executor``, ``fn`` runs there instead of on the completing
-        thread — e.g. the transport decodes received payloads on its
-        codec pool rather than the event loop.
+
+        THREADING CONTRACT: without ``executor``, ``fn`` runs inline on
+        whichever thread RESOLVES this ref — a task-pool worker, the
+        transport event loop, or the caller itself when the ref is
+        already done.  Callbacks must therefore be quick and non-blocking
+        (a slow callback on the event loop stalls every connection), and
+        must not assume any particular thread identity.  Pass
+        ``executor`` to move the work — e.g. the transport decodes
+        received payloads on its codec pool rather than the event loop.
         """
         out = LocalRef()
 
@@ -177,17 +183,39 @@ class TaskExecutor:
         args: tuple,
         kwargs: dict,
         num_returns: int = 1,
+        name: Optional[str] = None,
     ):
-        """Submit ``fn(*args, **kwargs)``; returns LocalRef or list of them."""
+        """Submit ``fn(*args, **kwargs)``; returns LocalRef or list of them.
+
+        ``name`` (defaults to the callable's ``__name__``) is stamped
+        onto the worker thread for the task's duration and into the
+        exception log line, so a traceback or a thread dump of a hung
+        party names the fed task instead of an anonymous
+        ``rayfed-worker-3``.
+        """
         if self._shutdown:
             raise RuntimeError("TaskExecutor has been shut down")
+        task_name = name or getattr(fn, "__name__", None) or repr(fn)
 
         def _run():
             if self._bind_runtime_fn is not None:
                 self._bind_runtime_fn()
-            resolved_args = tuple(_materialize_arg(a) for a in args)
-            resolved_kwargs = {k: _materialize_arg(v) for k, v in kwargs.items()}
-            return fn(*resolved_args, **resolved_kwargs)
+            thread = threading.current_thread()
+            base_name = thread.name
+            thread.name = f"{base_name}[{task_name}]"
+            try:
+                resolved_args = tuple(_materialize_arg(a) for a in args)
+                resolved_kwargs = {
+                    k: _materialize_arg(v) for k, v in kwargs.items()
+                }
+                return fn(*resolved_args, **resolved_kwargs)
+            except BaseException as e:
+                # The exception also travels to the LocalRef; this log
+                # line is the one place that pairs it with the task name.
+                logger.debug("fed task %r failed: %r", task_name, e)
+                raise
+            finally:
+                thread.name = base_name
 
         future = self._pool.submit(_run)
         if num_returns == 1:

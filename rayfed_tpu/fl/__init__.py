@@ -19,6 +19,7 @@ Here the common algorithms ship with the framework:
 """
 
 from rayfed_tpu.fl.compression import (
+    ErrorFeedback,
     PackedTree,
     compress,
     decompress,
@@ -26,7 +27,13 @@ from rayfed_tpu.fl.compression import (
     unpack_tree,
 )
 from rayfed_tpu.fl.dp import clip_by_global_norm, privatize
-from rayfed_tpu.fl.fedavg import aggregate, tree_average, tree_weighted_sum
+from rayfed_tpu.fl.fedavg import (
+    aggregate,
+    packed_weighted_sum,
+    tree_average,
+    tree_weighted_sum,
+)
+from rayfed_tpu.fl.streaming import StreamingAggregator, streaming_aggregate
 from rayfed_tpu.fl.fedopt import (
     fedprox_loss,
     server_adam,
@@ -45,6 +52,10 @@ from rayfed_tpu.fl.trainer import run_fedavg_rounds
 
 __all__ = [
     "aggregate",
+    "packed_weighted_sum",
+    "streaming_aggregate",
+    "StreamingAggregator",
+    "ErrorFeedback",
     "tree_average",
     "tree_weighted_sum",
     "SplitTrainer",

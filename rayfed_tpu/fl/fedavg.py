@@ -16,6 +16,27 @@ from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _check_weights(weights: Sequence[float]) -> float:
+    """Validated total of a weight vector.
+
+    An empty or all-zero (or non-finite) weight vector would silently
+    divide the aggregate by 0 — surface it as a ValueError naming the
+    problem instead of propagating inf/NaN params into the round."""
+    if len(weights) == 0:
+        raise ValueError("weights must be non-empty")
+    total = float(sum(float(w) for w in weights))
+    if total == 0.0:
+        raise ValueError(
+            "weights sum to zero (e.g. every party reported 0 examples) "
+            "— the weighted average is undefined; drop the round or pass "
+            "weights=None for a plain mean"
+        )
+    if not np.isfinite(total):
+        raise ValueError(f"weights sum to a non-finite value ({total})")
+    return total
 
 
 def _mean_leaf(*leaves):
@@ -38,8 +59,12 @@ def _tree_mean(trees: List[Any]) -> Any:
 
 
 def tree_weighted_sum(trees: Sequence[Any], weights: Sequence[float]) -> Any:
-    """Weighted sum of param pytrees (weights need not be normalized)."""
-    total = float(sum(weights))
+    """Weighted sum of param pytrees (weights need not be normalized).
+
+    Raises :class:`ValueError` on an empty or zero-sum weight vector
+    (the normalization below would otherwise divide by zero).
+    """
+    total = _check_weights(weights)
     norm = [w / total for w in weights]
 
     def _leaf(*leaves):
@@ -54,15 +79,128 @@ def tree_weighted_sum(trees: Sequence[Any], weights: Sequence[float]) -> Any:
     return jax.tree_util.tree_map(_leaf, *trees)
 
 
+@functools.lru_cache(maxsize=None)
+def _packed_reduce_jit(out_dtype_name: str):
+    """ONE fused program over the packed wire buffers: zero-init, then a
+    per-party multiply-add chain in f32, final divide + cast to the
+    output dtype.  The per-element op sequence is exactly the chain the
+    streaming aggregator's chunk kernel applies (fl.streaming), which is
+    what makes streamed and one-shot aggregation bit-identical."""
+
+    @jax.jit
+    def _reduce(bufs, w, total_w):
+        acc = jnp.zeros(bufs[0].shape, jnp.float32)
+        for i, b in enumerate(bufs):
+            acc = acc + w[i] * b.astype(jnp.float32)
+        return (acc / total_w).astype(jnp.dtype(out_dtype_name))
+
+    return _reduce
+
+
+def _reduce_passthrough(passthroughs, weights, total):
+    """Average the non-float (passthrough) leaf tuples of N PackedTrees
+    with :func:`tree_average`'s per-leaf semantics.  Shared by the
+    one-shot (:func:`packed_weighted_sum`) and streaming
+    (``fl.streaming``) reduces so the two stay result-identical."""
+    if not passthroughs[0]:
+        return ()
+    if weights is None:
+        return tuple(_mean_leaf(*ls) for ls in zip(*passthroughs))
+    norm = [float(x) / total for x in weights]
+
+    def _pt(*leaves):
+        acc = leaves[0] * norm[0]
+        for leaf, wt in zip(leaves[1:], norm[1:]):
+            acc = acc + leaf * wt
+        return acc
+
+    return tuple(_pt(*ls) for ls in zip(*passthroughs))
+
+
+def packed_weighted_sum(
+    packed_trees: Sequence[Any],
+    weights: Optional[Sequence[float]] = None,
+    out_dtype: Any = None,
+):
+    """Fused single-jit reduce over PackedTree contributions.
+
+    Instead of a tree_map over N full trees (one XLA op per leaf per
+    tree), the whole model reduces as ONE compiled chain over the packed
+    wire buffers — the same math the streaming path
+    (:class:`rayfed_tpu.fl.streaming.StreamingAggregator`) applies
+    chunk-by-chunk, so the two are bit-identical.  Passthrough
+    (non-float) leaves keep the per-leaf averaging semantics of
+    :func:`tree_average`.
+
+    ``out_dtype``: dtype of the returned packed buffer — defaults to
+    the contributions' wire dtype.  Pass f32 when the aggregate feeds a
+    server optimizer or an error-feedback loop: re-quantizing the mean
+    to an aggressive wire dtype here is exactly the loss no residual
+    would compensate.
+    """
+    from rayfed_tpu.fl.compression import PackedTree
+
+    packeds = list(packed_trees)
+    if not packeds:
+        raise ValueError("packed_weighted_sum needs at least one tree")
+    if not isinstance(packeds[0], PackedTree):
+        raise ValueError(
+            f"contribution 0 is not a PackedTree "
+            f"(got {type(packeds[0]).__name__}) — pack updates with "
+            f"fl.compress(tree, packed=True)"
+        )
+    spec = packeds[0].spec
+    for i, p in enumerate(packeds[1:], 1):
+        if not isinstance(p, PackedTree) or p.spec != spec:
+            raise ValueError(
+                f"contribution {i} is not a PackedTree with the same "
+                f"spec — all parties must pack the identical structure"
+            )
+    n = len(packeds)
+    if weights is None:
+        w = np.ones(n, np.float32)
+        total = float(n)
+    else:
+        if len(weights) != n:
+            raise ValueError(f"{len(weights)} weights for {n} trees")
+        total = _check_weights(weights)
+        w = np.asarray([float(x) for x in weights], np.float32)
+    out_name = np.dtype(
+        out_dtype if out_dtype is not None else packeds[0].buf.dtype
+    ).name
+    buf = _packed_reduce_jit(out_name)(
+        tuple(p.buf for p in packeds), jnp.asarray(w), np.float32(total)
+    )
+    passthrough = _reduce_passthrough(
+        [p.passthrough for p in packeds], weights, total
+    )
+    if out_name != spec.wire_dtype:
+        from rayfed_tpu.fl.compression import PackSpec
+
+        spec = PackSpec(spec.entries, spec.treedef, out_name)
+    return PackedTree(buf, passthrough, spec)
+
+
 def tree_average(trees: Sequence[Any], weights: Optional[Sequence[float]] = None):
-    """Mean (or example-count-weighted mean) of param pytrees."""
+    """Mean (or example-count-weighted mean) of param pytrees.
+
+    PackedTree contributions with a shared spec take the fused
+    single-jit reduce (:func:`packed_weighted_sum`): one compiled chain
+    over the packed buffers instead of per-leaf dispatches.
+    """
     trees = list(trees)
     if not trees:
         raise ValueError("tree_average needs at least one tree")
+    if weights is not None and len(weights) != len(trees):
+        raise ValueError(f"{len(weights)} weights for {len(trees)} trees")
+    from rayfed_tpu.fl.compression import PackedTree
+
+    if all(isinstance(t, PackedTree) for t in trees) and all(
+        t.spec == trees[0].spec for t in trees[1:]
+    ):
+        return packed_weighted_sum(trees, weights)
     if weights is None:
         return _tree_mean(trees)
-    if len(weights) != len(trees):
-        raise ValueError(f"{len(weights)} weights for {len(trees)} trees")
     return tree_weighted_sum(trees, tuple(float(w) for w in weights))
 
 

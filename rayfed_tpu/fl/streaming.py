@@ -1,0 +1,760 @@
+"""Streaming on-device aggregation of PackedTree contributions.
+
+The classic FedAvg receive path serializes: wait for every party's
+complete payload → decode N full trees → one monolithic reduce.  At
+model scale that parks O(parties × model) bytes on the coordinator and
+leaves the devices idle while the wire drains.  Here aggregation is
+fused into the receive path (THC-style, arXiv:2302.08545): the transport
+surfaces payload bytes **as they land** (``TransportManager.recv_stream``
+→ ``TransportServer`` chunk sinks), and a :class:`StreamingAggregator`
+casts + accumulates each arriving chunk of the packed wire buffer into a
+**donated on-device f32 accumulator** while later chunks are still on
+the wire — wire time and decode+reduce time overlap, and the reduce
+itself never materializes a list of full trees.  (Delta streams trade
+memory for wire on top of this: the transport keeps each peer's last
+full payload as the diff base — see the transport docs.)
+
+Determinism contract: floating-point addition is not associative, so the
+aggregator applies chunks in **party order per block** — party ``i``'s
+block ``b`` is folded in only after parties ``0..i-1`` folded theirs.
+Arrival order then only affects scheduling, never the result: the
+streamed aggregate is bit-identical to the one-shot fused reduce
+(:func:`rayfed_tpu.fl.fedavg.packed_weighted_sum`), which performs the
+same zero-init → per-party multiply-add chain → final divide + cast.
+
+Non-float (passthrough) leaves are reduced at finalize time with the
+same per-leaf semantics as :func:`~rayfed_tpu.fl.fedavg.tree_average`
+(the payloads are retained as zero-copy views, so decoding their
+skeletons is cheap) — streamed and one-shot aggregation agree on the
+whole tree, not just the packed buffer.
+
+``streaming_aggregate`` is the multi-controller entry point: every party
+calls it at the same program point with the same arguments (like
+:func:`rayfed_tpu.fl.fedavg.aggregate`); contributions flow to the
+coordinator on named delta streams (only changed chunks cross the wire
+round-over-round) and the result is broadcast back.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Elements folded per accumulate dispatch.  2M elements = one 4 MB
+# bf16 wire chunk — matching the transport's chunk size keeps roughly
+# one dispatch per arriving chunk.
+DEFAULT_CHUNK_ELEMS = 1 << 21
+
+# A sink only wakes the aggregator worker after this many new bytes
+# (or on completion) — per-64KB-read notifies would thrash the lock.
+_NOTIFY_BYTES = 512 * 1024
+
+
+@functools.lru_cache(maxsize=None)
+def _accum_kernel(chunk_elems: int, acc_dtype: str, wire_dtype: str):
+    """One donated-accumulator multiply-add step: acc[off:off+C] += w*x.
+
+    The donated accumulator means no second O(model) buffer per step;
+    offsets are traced (one compile per (chunk size, dtypes), not per
+    offset).  The per-element op chain — convert, multiply by the traced
+    weight, add — is EXACTLY the chain ``packed_weighted_sum`` compiles,
+    which is what makes streamed and one-shot aggregation bit-identical.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    acc_dt = jnp.dtype(acc_dtype)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _apply(acc, chunk, off, w):
+        seg = jax.lax.dynamic_slice(acc, (off,), (chunk_elems,))
+        return jax.lax.dynamic_update_slice(
+            acc, seg + w * chunk.astype(acc_dt), (off,)
+        )
+
+    return _apply
+
+
+@functools.lru_cache(maxsize=None)
+def _finalize_kernel(total_elems: int, acc_dtype: str, out_dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _finish(acc, total_w):
+        return (acc[:total_elems] / total_w).astype(jnp.dtype(out_dtype))
+
+    return _finish
+
+
+class _Stream:
+    """Receive state of one contribution."""
+
+    __slots__ = (
+        "payload", "avail_bytes", "complete", "local_tree", "elems_array",
+        "data_start", "data_nbytes", "dtype", "applied_blocks",
+        "t_complete", "notified_bytes",
+    )
+
+    def __init__(self) -> None:
+        self.payload: Optional[memoryview] = None
+        self.avail_bytes = 0
+        self.complete = False
+        self.local_tree = None  # coordinator's own PackedTree
+        self.elems_array: Optional[np.ndarray] = None  # local fast path
+        self.data_start = -1  # byte offset of the packed buffer
+        self.data_nbytes = -1
+        self.dtype: Optional[np.dtype] = None
+        self.applied_blocks = 0
+        self.t_complete = 0.0
+        self.notified_bytes = 0
+
+
+class _StreamSink:
+    """Transport-facing adapter: thread-safe, throttled notifies."""
+
+    __slots__ = ("_agg", "_index")
+
+    def __init__(self, agg: "StreamingAggregator", index: int) -> None:
+        self._agg = agg
+        self._index = index
+
+    def on_bytes(self, view: memoryview, total: int) -> None:
+        self._agg._on_bytes(self._index, view, total)
+
+    def on_complete(self, payload) -> None:
+        self._agg._on_complete(self._index, payload)
+
+    def on_error(self, err: Any) -> None:
+        self._agg._on_error(self._index, err)
+
+    def on_frame_abort(self, corrupt: bool = False) -> None:
+        self._agg._on_frame_abort(self._index, corrupt)
+
+
+class StreamingAggregator:
+    """Fold N PackedTree contributions into one as their bytes arrive.
+
+    Usage (coordinator side)::
+
+        agg = StreamingAggregator(n_sources=len(parties), weights=w)
+        for i, party in enumerate(parties):
+            transport.recv_stream(party, up_id, down_id, agg.sink(i))
+        agg.add_local(my_index, my_packed_tree)   # no wire hop for self
+        averaged = agg.result(timeout=60)         # PackedTree, wire dtype
+
+    The REDUCE itself holds O(model + chunk): one f32 accumulator, no
+    list of decoded per-leaf trees.  Wire-payload residency is separate:
+    in-flight payload buffers live until their frame completes, and when
+    contributions ride delta streams the transport additionally caches
+    each peer's last full payload (bounded LRU) as the diff base — that
+    is a deliberate memory-for-wire trade, O(streams × wire payload),
+    accounted to the transport, not this reducer.
+    """
+
+    def __init__(
+        self,
+        n_sources: int,
+        weights: Optional[Sequence[float]] = None,
+        allowed: Optional[Dict[str, Any]] = None,
+        chunk_elems: int = DEFAULT_CHUNK_ELEMS,
+        out_dtype: Any = None,
+    ) -> None:
+        if n_sources < 1:
+            raise ValueError("streaming aggregation needs >= 1 source")
+        if weights is not None:
+            from rayfed_tpu.fl.fedavg import _check_weights
+
+            if len(weights) != n_sources:
+                raise ValueError(
+                    f"{len(weights)} weights for {n_sources} sources"
+                )
+            self._weights = [float(w) for w in weights]
+            self._total_w = _check_weights(self._weights)
+        else:
+            self._weights = [1.0] * n_sources
+            self._total_w = float(n_sources)
+        # Original arg (None vs explicit): the passthrough reduce must
+        # take the same code path as packed_weighted_sum's.
+        self._weights_arg = (
+            None if weights is None else list(self._weights)
+        )
+        self._allowed = allowed
+        # Output dtype of the aggregate (None = the wire dtype).  Keep
+        # f32 when the result feeds a server optimizer or error-feedback
+        # loop — re-quantizing the mean to an aggressive wire dtype is
+        # exactly the loss no residual compensates.
+        self._out_dtype = None if out_dtype is None else np.dtype(out_dtype)
+        self._chunk_elems = int(chunk_elems)
+        self._n = n_sources
+        self._streams = [_Stream() for _ in range(n_sources)]
+        self._cond = threading.Condition()
+        self._acc = None
+        self._total_elems = -1
+        self._nblocks = -1
+        self._wire_dtype: Optional[np.dtype] = None
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+        self._worker: Optional[threading.Thread] = None
+        # Timing for the overlap metric.
+        self._t_first_byte = 0.0
+        self._t_all_complete = 0.0
+        self._t_done = 0.0
+        self._busy_s = 0.0
+        self.stats: Dict[str, float] = {}
+
+    # -- source attachment ----------------------------------------------------
+
+    def sink(self, index: int) -> _StreamSink:
+        """The chunk sink for source ``index`` (hand to recv_stream)."""
+        self._ensure_worker()
+        return _StreamSink(self, index)
+
+    def add_local(self, index: int, packed_tree: Any) -> None:
+        """Feed the coordinator's own contribution (no wire hop)."""
+        from rayfed_tpu.fl.compression import PackedTree
+
+        if not isinstance(packed_tree, PackedTree):
+            self.fail(
+                TypeError(
+                    "streaming aggregation consumes PackedTree "
+                    f"contributions, got {type(packed_tree).__name__} — "
+                    "produce updates with fl.compress(tree, packed=True)"
+                )
+            )
+            return
+        self._ensure_worker()
+        arr = np.asarray(packed_tree.buf).reshape(-1)
+        now = time.perf_counter()
+        with self._cond:
+            s = self._streams[index]
+            s.local_tree = packed_tree
+            s.elems_array = arr
+            s.dtype = arr.dtype
+            s.data_start = 0
+            s.data_nbytes = arr.nbytes
+            s.avail_bytes = arr.nbytes
+            s.complete = True
+            s.t_complete = now
+            if not self._t_first_byte:
+                self._t_first_byte = now
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._error is None:
+                self._error = exc
+            self._cond.notify_all()
+
+    # -- sink callbacks (transport threads) -----------------------------------
+
+    def _on_bytes(self, index: int, view: memoryview, total: int) -> None:
+        s = self._streams[index]
+        # ALL state writes happen under the lock — a lockless extent
+        # update could race a frame abort's reset and carry a dead
+        # frame's byte count onto the retry's fresh buffer.  Only the
+        # worker WAKE is throttled (the lock itself is ~100ns; the
+        # notify storm is what would thrash).
+        with self._cond:
+            if s.complete:
+                return
+            if s.payload is not None and s.payload.obj is not view.obj:
+                # A retry frame with a fresh buffer: drop the stale
+                # binding (already-applied blocks stay — a retry resends
+                # the identical payload, so they remain a valid prefix).
+                self._reset_frame(s)
+            if s.payload is None:
+                s.payload = view
+                if not self._t_first_byte:
+                    self._t_first_byte = time.perf_counter()
+                s.avail_bytes = total
+            else:
+                s.avail_bytes = max(s.avail_bytes, total)
+            if total - s.notified_bytes >= _NOTIFY_BYTES:
+                s.notified_bytes = total
+                self._cond.notify_all()
+
+    def _on_complete(self, index: int, payload) -> None:
+        now = time.perf_counter()
+        with self._cond:
+            s = self._streams[index]
+            # Delta frames (and mailbox replays) deliver a payload
+            # object the incremental view never saw — rebind.
+            s.payload = memoryview(payload)
+            s.avail_bytes = len(s.payload)
+            s.complete = True
+            s.t_complete = now
+            if not self._t_first_byte:
+                self._t_first_byte = now
+            self._cond.notify_all()
+
+    def _on_error(self, index: int, err: Any) -> None:
+        from rayfed_tpu.exceptions import RemoteError
+
+        if isinstance(err, BaseException):
+            exc: BaseException = err
+        else:
+            try:
+                exc = RemoteError.from_wire(err)
+            except Exception:
+                exc = RuntimeError(f"stream {index} failed: {err!r}")
+        self.fail(exc)
+
+    @staticmethod
+    def _reset_frame(s: _Stream) -> None:
+        """Forget a dead frame's buffer; keep the applied-block prefix
+        (a sender retry re-sends the identical payload bytes)."""
+        s.payload = None
+        s.avail_bytes = 0
+        s.notified_bytes = 0
+        s.data_start = -1
+        s.data_nbytes = -1
+        s.dtype = None
+
+    def _on_frame_abort(self, index: int, corrupt: bool) -> None:
+        """The in-flight frame died (connection drop) or failed
+        verification.  A clean drop just resets the frame state and
+        waits for the sender's retry; a CORRUPT frame whose bytes were
+        already folded cannot be rolled back out of the donated
+        accumulator — fail the aggregation loudly rather than let a
+        retry land on top of poisoned partial sums."""
+        with self._cond:
+            s = self._streams[index]
+            if s.complete:
+                return
+            if corrupt and s.applied_blocks > 0:
+                self._error = RuntimeError(
+                    f"contribution {index} failed verification after "
+                    f"{s.applied_blocks} of its blocks were already "
+                    f"aggregated — the donated accumulator cannot be "
+                    f"rolled back; re-run the round"
+                )
+            else:
+                self._reset_frame(s)
+            self._cond.notify_all()
+
+    # -- result ---------------------------------------------------------------
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until every contribution streamed in; the aggregate as a
+        :class:`~rayfed_tpu.fl.compression.PackedTree` in the wire dtype
+        (``unpack``/``decompress`` restores the compute-dtype tree)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._done and self._error is None:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._error = TimeoutError(
+                            f"streaming aggregation timed out after "
+                            f"{timeout}s"
+                        )
+                        self._cond.notify_all()
+                        break
+                self._cond.wait(timeout=remaining)
+            if self._error is not None:
+                raise self._error
+            return self._result
+
+    @property
+    def agg_overlap_frac(self) -> float:
+        """Fraction of aggregation busy time hidden under the wire."""
+        return self.stats.get("agg_overlap_frac", 0.0)
+
+    # -- worker ---------------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._cond:
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._run,
+                    name="rayfed-stream-agg",
+                    daemon=True,
+                )
+                self._worker.start()
+
+    def _parse_layout(self, s: _Stream) -> bool:
+        """Locate the packed buffer inside the payload (needs only the
+        manifest + skeleton-length prefix, i.e. the first chunk)."""
+        if s.data_start >= 0:
+            return True
+        if s.payload is None or s.avail_bytes < 4:
+            return False
+        mv = s.payload
+        (mlen,) = struct.unpack(">I", bytes(mv[:4]))
+        if s.avail_bytes < 4 + mlen:
+            return False
+        manifest = json.loads(bytes(mv[4 : 4 + mlen]))
+        leaves = manifest["leaves"]
+        if not leaves or leaves[0]["k"] not in ("nd", "nds"):
+            raise ValueError(
+                "streaming aggregation expects a PackedTree payload "
+                "(leaf 0 must be the packed wire buffer) — produce "
+                "updates with fl.compress(tree, packed=True)"
+            )
+        spec = leaves[0]
+        start = 4 + mlen + manifest["skel"]
+        if spec["k"] == "nd":
+            nbytes = spec["n"]
+        else:
+            nbytes = sum(e["n"] for e in spec["shards"])
+        s.data_start = start
+        s.data_nbytes = nbytes
+        s.dtype = np.dtype(spec["dtype"])
+        return True
+
+    def _init_acc(self, s: _Stream) -> None:
+        import jax.numpy as jnp
+
+        itemsize = s.dtype.itemsize
+        if s.data_nbytes % itemsize:
+            raise ValueError("packed buffer not a whole element count")
+        self._total_elems = s.data_nbytes // itemsize
+        if self._total_elems >= 2**31:
+            # Accumulator offsets ride int32 (jax's default index dtype
+            # with x64 disabled) — beyond this a fold would silently
+            # land at a wrapped offset.  Shard the model across several
+            # packed trees before streaming at that scale.
+            raise ValueError(
+                f"packed buffer has {self._total_elems} elements — "
+                f"streaming aggregation supports < 2**31 elements per "
+                f"buffer; split the tree into multiple packed buffers"
+            )
+        self._wire_dtype = s.dtype
+        self._nblocks = max(
+            1, -(-self._total_elems // self._chunk_elems)
+        )
+        self._acc = jnp.zeros(
+            self._nblocks * self._chunk_elems, jnp.float32
+        )
+
+    def _avail_blocks(self, s: _Stream) -> int:
+        if s.complete:
+            return self._nblocks
+        if s.data_start < 0 or s.dtype is None:
+            return 0
+        avail_elems = max(
+            0, (min(s.avail_bytes, s.data_start + s.data_nbytes)
+                - s.data_start) // s.dtype.itemsize
+        )
+        return min(self._nblocks, avail_elems // self._chunk_elems)
+
+    def _chunk_np(self, src: tuple, block: int) -> np.ndarray:
+        """The block's wire elements, zero-padded at the buffer tail.
+
+        ``src`` is an under-the-lock snapshot of the stream's
+        ``(elems_array, payload, dtype, data_start)``: a concurrent
+        frame abort may null the live stream fields mid-fold, but the
+        snapshot's bytes are a stable valid prefix of the logical
+        payload (a sender retry resends identical bytes)."""
+        elems_array, payload, dtype, data_start = src
+        ce = self._chunk_elems
+        first = block * ce
+        count = min(ce, self._total_elems - first)
+        if elems_array is not None:
+            arr = elems_array[first : first + count]
+        else:
+            arr = np.frombuffer(
+                payload,
+                dtype=dtype,
+                count=count,
+                offset=data_start + first * dtype.itemsize,
+            )
+        if count < ce:
+            pad = np.zeros(ce, dtype)
+            pad[:count] = arr
+            arr = pad
+        return arr
+
+    def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException as e:  # pragma: no cover - defensive
+            logger.exception("streaming aggregator worker failed")
+            self.fail(e)
+
+    def _run_inner(self) -> None:
+        kernel = None
+        while True:
+            with self._cond:
+                if self._error is not None:
+                    return
+                # Snapshot availability; validate layouts lazily.
+                work: List[tuple] = []
+                try:
+                    for i, s in enumerate(self._streams):
+                        if s.dtype is None and not self._parse_layout(s):
+                            continue
+                        if self._acc is None:
+                            self._init_acc(s)
+                        if (
+                            s.data_nbytes
+                            != self._total_elems * self._wire_dtype.itemsize
+                            or s.dtype != self._wire_dtype
+                        ):
+                            raise ValueError(
+                                f"contribution {i} layout mismatch: "
+                                f"{s.data_nbytes}B {s.dtype} vs "
+                                f"{self._total_elems} elems of "
+                                f"{self._wire_dtype} — all parties must "
+                                f"pack the same tree structure"
+                            )
+                except Exception as e:
+                    self._error = e
+                    self._cond.notify_all()
+                    return
+                if self._acc is not None:
+                    # Party-order-per-block schedule: stream i may fold
+                    # block b only once streams 0..i-1 folded theirs —
+                    # the result is then independent of arrival order.
+                    # The chunk source is snapshotted HERE, under the
+                    # lock (see _chunk_np).
+                    for i, s in enumerate(self._streams):
+                        limit = (
+                            self._streams[i - 1].applied_blocks
+                            if i else self._nblocks
+                        )
+                        target = min(self._avail_blocks(s), limit)
+                        if target > s.applied_blocks:
+                            work.append((
+                                i, s.applied_blocks, target,
+                                (s.elems_array, s.payload, s.dtype,
+                                 s.data_start),
+                            ))
+                all_complete = all(s.complete for s in self._streams)
+                if not work:
+                    if all_complete and self._acc is not None and all(
+                        s.applied_blocks == self._nblocks
+                        for s in self._streams
+                    ):
+                        break  # everything folded — finalize below
+                    self._cond.wait(timeout=0.5)
+                    continue
+                if all_complete and not self._t_all_complete:
+                    self._t_all_complete = max(
+                        s.t_complete for s in self._streams
+                    )
+            # Apply outside the lock (sinks keep landing bytes meanwhile).
+            if kernel is None:
+                kernel = _accum_kernel(
+                    self._chunk_elems, "float32", str(self._wire_dtype)
+                )
+            for i, lo, hi, src in work:
+                s = self._streams[i]
+                w = np.float32(self._weights[i])
+                t0 = time.perf_counter()
+                for b in range(lo, hi):
+                    self._acc = kernel(
+                        self._acc,
+                        self._chunk_np(src, b),
+                        np.int32(b * self._chunk_elems),
+                        w,
+                    )
+                self._busy_s += time.perf_counter() - t0
+                with self._cond:
+                    s.applied_blocks = hi
+
+        # Finalize: divide + cast once, rebuild the PackedTree around
+        # the aggregated buffer (spec/passthrough from one template
+        # contribution — they are structural, identical across parties).
+        t0 = time.perf_counter()
+        out_dt = self._out_dtype or self._wire_dtype
+        finish = _finalize_kernel(
+            self._total_elems, "float32", str(out_dt)
+        )
+        out_buf = finish(self._acc, np.float32(self._total_w))
+        out_buf.block_until_ready()
+        template = self._template_tree()
+        from rayfed_tpu.fl.compression import PackedTree, PackSpec
+
+        passthrough = template.passthrough
+        if passthrough:
+            # Non-float leaves get the same per-leaf averaging the
+            # one-shot path applies (every payload is still retained as
+            # a zero-copy view, so decoding the skeletons is cheap).
+            from rayfed_tpu.fl.fedavg import _reduce_passthrough
+
+            passthrough = _reduce_passthrough(
+                [t.passthrough for t in map(self._tree_of, self._streams)],
+                self._weights_arg,
+                self._total_w,
+            )
+        spec = template.spec
+        if str(out_dt) != spec.wire_dtype:
+            spec = PackSpec(spec.entries, spec.treedef, np.dtype(out_dt).name)
+        result = PackedTree(out_buf, passthrough, spec)
+        self._busy_s += time.perf_counter() - t0
+        self._t_done = time.perf_counter()
+        if not self._t_all_complete:
+            self._t_all_complete = self._t_done
+        tail_s = max(0.0, self._t_done - self._t_all_complete)
+        busy = max(self._busy_s, 1e-9)
+        self.stats = {
+            "agg_busy_s": self._busy_s,
+            "agg_tail_s": tail_s,
+            "agg_wire_s": max(
+                0.0, self._t_all_complete - self._t_first_byte
+            ),
+            "agg_overlap_frac": min(1.0, max(0.0, 1.0 - tail_s / busy)),
+        }
+        with self._cond:
+            self._result = result
+            self._done = True
+            self._cond.notify_all()
+
+    def _tree_of(self, s: _Stream):
+        from rayfed_tpu.fl.compression import PackedTree
+        from rayfed_tpu.transport import wire as wire_mod
+
+        if s.local_tree is not None:
+            return s.local_tree
+        tree = wire_mod.decode_payload(
+            s.payload, allowed=self._allowed, zero_copy=True
+        )
+        if not isinstance(tree, PackedTree):
+            raise TypeError(
+                "streaming aggregation consumes PackedTree payloads, got "
+                f"{type(tree).__name__}"
+            )
+        return tree
+
+    def _template_tree(self):
+        for s in self._streams:
+            if s.local_tree is not None:
+                return s.local_tree
+        return self._tree_of(self._streams[0])
+
+
+def streaming_aggregate(
+    fed_objects: Sequence[Any],
+    weights: Optional[Sequence[float]] = None,
+    *,
+    coordinator: Optional[str] = None,
+    stream: str = "sagg",
+    timeout: Optional[float] = None,
+    out_dtype: Any = None,
+) -> Any:
+    """FedAvg round over the streaming + delta-cache pipeline.
+
+    Drop-in for ``fl.aggregate(...)`` in coordinator topology when the
+    contributions are PackedTrees: every party calls it at the same
+    program point with the same arguments.  Owners push their update to
+    the coordinator on a per-party **delta stream** (round-over-round
+    unchanged chunks never cross the wire); the coordinator folds each
+    arriving chunk into a donated on-device accumulator while later
+    chunks are in flight, and broadcasts the aggregate (also on a delta
+    stream).  Returns the averaged PackedTree on every party.
+
+    ``stream`` names the delta-cache scope — keep it constant across
+    rounds of the same training loop so the caches hit.
+
+    Multi-host parties: only the party LEADER process runs the
+    cross-party wire, so streaming aggregation works on the leader and
+    raises ``NotImplementedError`` on non-leader coordinator processes
+    — use :func:`rayfed_tpu.fl.aggregate` for multi-host coordinators.
+    """
+    from rayfed_tpu.fed_object import FedObject
+    from rayfed_tpu.proxy import recv_on_runtime, send_on_runtime
+    from rayfed_tpu.runtime import get_runtime
+
+    runtime = get_runtime()
+    objs = list(fed_objects)
+    if not objs:
+        raise ValueError("streaming_aggregate needs at least one object")
+    if weights is not None and len(weights) != len(objs):
+        raise ValueError(
+            f"{len(weights)} weights for {len(objs)} objects"
+        )
+    for obj in objs:
+        if not isinstance(obj, FedObject):
+            raise TypeError(
+                "streaming_aggregate consumes FedObjects (party-owned "
+                f"contributions), got {type(obj).__name__}"
+            )
+    # Allocated identically on every controller — the determinism
+    # contract that keys the rendezvous.
+    contrib_id = runtime.next_seq_id()
+    result_id = runtime.next_seq_id()
+    me = runtime.party
+    coord = coordinator or objs[0].get_party()
+    backstop = timeout if timeout is not None else runtime.job_config.recv_backstop_s
+    parties = list(runtime.cluster_config.parties)
+
+    if me != coord:
+        own_seq = 0  # per-OWNER ordinal: stable under client sampling,
+        # unlike the global position (which churns with the active set
+        # and would rotate delta-stream names every round).
+        for obj in objs:
+            if obj.get_party() == me:
+                send_on_runtime(
+                    runtime, coord, obj.get_local_ref(),
+                    obj.get_fed_task_id(), contrib_id,
+                    stream=f"{stream}/up/{me}/{own_seq}",
+                )
+                own_seq += 1
+        ref = recv_on_runtime(runtime, coord, result_id, result_id)
+        return ref.resolve(timeout=backstop)
+
+    agg = StreamingAggregator(
+        len(objs),
+        weights=weights,
+        allowed=runtime.cluster_config.serializing_allowed_list,
+        out_dtype=out_dtype,
+    )
+    pending_cancels: List[tuple] = []
+    for i, obj in enumerate(objs):
+        if obj.get_party() == me:
+            local_ref = obj.get_local_ref()
+
+            def _feed(ref, i=i):
+                exc = ref.exception()
+                if exc is not None:
+                    agg.fail(exc)
+                else:
+                    agg.add_local(i, ref.resolve())
+
+            local_ref.add_done_callback(_feed)
+        else:
+            runtime.transport.recv_stream(
+                obj.get_party(), obj.get_fed_task_id(), contrib_id,
+                agg.sink(i),
+            )
+            pending_cancels.append((obj.get_fed_task_id(), contrib_id))
+    others = [p for p in parties if p != me]
+    try:
+        result = agg.result(timeout=backstop)
+    except BaseException as exc:
+        for up, down in pending_cancels:
+            runtime.transport.cancel_stream(up, down)
+        # Fail-fast parity with aggregate(): the peers are parked on the
+        # result broadcast — poison that key so their recv raises the
+        # coordinator's error now, not after the hour-long backstop.
+        poison = getattr(runtime.transport, "_send_poison", None)
+        if poison is not None:
+            for p in others:
+                try:
+                    poison(p, result_id, result_id, exc)
+                except Exception:  # pragma: no cover - best effort
+                    logger.exception(
+                        "failed to poison streaming result for %s", p
+                    )
+        raise
+    from rayfed_tpu.proxy import send_many_on_runtime
+
+    if others:
+        send_many_on_runtime(
+            runtime, others, result, result_id, result_id,
+            stream=f"{stream}/down",
+        )
+    return result

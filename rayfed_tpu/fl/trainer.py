@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Sequence
 
-from rayfed_tpu.fl.compression import compress, decompress
+from rayfed_tpu.fl.compression import ErrorFeedback, compress, decompress
 from rayfed_tpu.fl.fedavg import aggregate
 from rayfed_tpu.fl.fedopt import ServerOptimizer
 
@@ -58,6 +58,9 @@ def run_fedavg_rounds(
     sample: Optional[int] = None,
     sample_seed: int = 0,
     aggregator: Optional[Callable[[Sequence[Any]], Any]] = None,
+    streaming_agg: bool = False,
+    error_feedback: bool = False,
+    wire_dtype: Any = None,
 ) -> Any:
     """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
 
@@ -94,6 +97,27 @@ def run_fedavg_rounds(
       fl.tree_trimmed_mean, trim=1)``, or a Krum selection.
       Materializes every round (the reducer needs raw values) and is
       mutually exclusive with ``weights``.
+    - ``streaming_agg``: aggregate each round with
+      :func:`rayfed_tpu.fl.streaming.streaming_aggregate` instead of
+      the one-shot fetch+reduce: the coordinator folds each arriving
+      contribution chunk into a donated on-device accumulator while
+      later chunks are on the wire, and contributions/broadcasts ride
+      per-peer **delta streams** (unchanged chunks never re-cross the
+      wire).  Requires ``compress_wire`` + ``packed_wire`` (the
+      streamed unit is the packed buffer) and materializes every round;
+      bit-identical to the one-shot path.
+    - ``error_feedback``: carry the wire quantization error of the
+      outgoing (driver→trainer) compressed model into the next round
+      (:class:`rayfed_tpu.fl.ErrorFeedback`) — keeps aggressive wire
+      dtypes convergent.  Requires ``compress_wire`` + ``packed_wire``
+      (the residual is carried on the packed buffer) and materializes
+      every round (the driver must hold the round's tree to correct
+      it).  Trainer-side updates compress inside the trainer's own
+      ``train``; give each trainer its own ErrorFeedback instance for
+      full bidirectional feedback.
+    - ``wire_dtype``: the compressed wire dtype for the driver's
+      outgoing pushes (default bf16).  Pair an aggressive choice (e.g.
+      ``jnp.float8_e4m3fn``) with ``error_feedback=True``.
 
     Without a server optimizer the rounds **pipeline**: the averaged
     model flows into the next round as a lazy ``FedObject`` (no
@@ -127,6 +151,23 @@ def run_fedavg_rounds(
             "sample and weights are mutually exclusive (a weight "
             "sequence cannot align with a changing per-round subset)"
         )
+    if streaming_agg and not (compress_wire and packed_wire):
+        raise ValueError(
+            "streaming_agg requires compress_wire=True and "
+            "packed_wire=True (the streamed unit is the packed wire "
+            "buffer)"
+        )
+    if streaming_agg and aggregator is not None:
+        raise ValueError(
+            "streaming_agg and aggregator are mutually exclusive (a "
+            "custom reducer needs the raw per-party values)"
+        )
+    if error_feedback and not (compress_wire and packed_wire):
+        raise ValueError(
+            "error_feedback requires compress_wire=True and "
+            "packed_wire=True (the residual is carried on the packed "
+            "wire buffer)"
+        )
 
     from rayfed_tpu.fed_object import FedObject
 
@@ -152,8 +193,17 @@ def run_fedavg_rounds(
         and on_round is None
         and not checkpoint_every
         and aggregator is None  # a reducer needs the raw values
+        and not streaming_agg  # streaming materializes at the reducer
+        and not error_feedback  # the residual needs the driver's tree
         and len(trainers) > 1
     )
+    # ``wire_dtype`` (default bf16) is where error feedback earns its
+    # keep: fp8 wire halves bf16's bytes again, and the carried
+    # residual is what keeps it convergent.
+    import jax.numpy as _jnp
+
+    wire_dt = _jnp.bfloat16 if wire_dtype is None else wire_dtype
+    ef = ErrorFeedback(wire_dt) if error_feedback else None
 
     parties = list(trainers)
 
@@ -170,14 +220,20 @@ def run_fedavg_rounds(
 
     for r in range(start_round, rounds):
         active = round_parties(r)
-        # Wire form: a driver-held tree is compressed before the push;
-        # a lazy FedObject from a pipelined round is already the
-        # trainers' own (compressed) wire form.
-        outgoing = (
-            compress(current, packed=packed_wire)
-            if compress_wire and not isinstance(current, FedObject)
-            else current
-        )
+        # Wire form: a driver-held tree is compressed before the push
+        # (with the carried error-feedback residual folded in, when
+        # enabled); a lazy FedObject from a pipelined round is already
+        # the trainers' own (compressed) wire form.
+        if compress_wire and not isinstance(current, FedObject):
+            outgoing = (
+                ef.compress(current)
+                if ef is not None
+                else compress(
+                    current, packed=packed_wire, wire_dtype=wire_dt
+                )
+            )
+        else:
+            outgoing = current
         updates = [trainers[p].train.remote(outgoing) for p in active]
         if pipeline:
             last = r == rounds - 1
@@ -193,8 +249,30 @@ def run_fedavg_rounds(
 
         # aggregate() owns the wire topology for both the mean and a
         # custom reducer (coordinator-side reduce + broadcast at N>2) —
-        # one place decides who talks to whom.
-        avg = aggregate(updates, weights, reducer=aggregator)
+        # one place decides who talks to whom.  The streaming path rides
+        # the same coordinator topology but folds contributions in as
+        # their chunks arrive (bit-identical result).
+        if streaming_agg:
+            from rayfed_tpu.fl.streaming import streaming_aggregate
+
+            # With error feedback (or a server optimizer) the aggregate
+            # must come back in f32: casting the mean to an aggressive
+            # wire dtype here would re-quantize it with no residual to
+            # compensate (the broadcast's delta cache still applies).
+            # Coordinator pinned to the canonically-first party (NOT the
+            # round's first active party): with client sampling the
+            # active set churns, and a rotating coordinator would churn
+            # every delta-stream destination — defeating the caches and
+            # retaining stale full-payload bases on every peer.
+            avg = streaming_aggregate(
+                updates, weights, stream="fedavg",
+                coordinator=min(trainers),
+                out_dtype="float32"
+                if (error_feedback or server_opt is not None)
+                else None,
+            )
+        else:
+            avg = aggregate(updates, weights, reducer=aggregator)
         if compress_wire:
             avg = decompress(avg)
         if server_opt is not None:

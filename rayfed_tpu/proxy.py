@@ -20,7 +20,10 @@ def send_on_runtime(
     data: Any,
     upstream_seq_id: Any,
     downstream_seq_id: Any,
+    stream: Any = None,
 ) -> LocalRef:
+    """``stream``: stable stream name enabling the transport's per-peer
+    delta cache (ship only changed chunks — see TransportClient)."""
     if runtime.send_proxy is None:
         raise RuntimeError("transport not started; call fed.init() first")
     result_ref = runtime.send_proxy.send(
@@ -28,6 +31,7 @@ def send_on_runtime(
         data=data,
         upstream_seq_id=upstream_seq_id,
         downstream_seq_id=downstream_seq_id,
+        stream=stream,
     )
     if runtime.cleanup_manager is not None:
         runtime.cleanup_manager.push_to_sending(result_ref)
@@ -40,6 +44,7 @@ def send_many_on_runtime(
     data: Any,
     upstream_seq_id: Any,
     downstream_seq_id: Any,
+    stream: Any = None,
 ) -> dict:
     """Broadcast fan-out: ONE payload encode shared by every destination.
 
@@ -56,6 +61,7 @@ def send_many_on_runtime(
         data=data,
         upstream_seq_id=upstream_seq_id,
         downstream_seq_id=downstream_seq_id,
+        stream=stream,
     )
     if runtime.cleanup_manager is not None:
         for ref in refs.values():

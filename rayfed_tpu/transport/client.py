@@ -19,6 +19,7 @@ matched by request id on each connection's reader task.
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import json
 import logging
@@ -40,6 +41,12 @@ logger = logging.getLogger(__name__)
 # of lookahead memory.
 WRITE_CHUNK_BYTES = 4 * 1024 * 1024
 
+# Delta-stream states retained per client: each holds a full payload
+# snapshot, so a caller cycling stream names (against the keep-it-
+# constant guidance) must evict instead of growing without bound.
+# Mirrors the server's _MAX_DELTA_BASES.
+_MAX_DELTA_STREAMS = 32
+
 
 class SendError(ConnectionError):
     pass
@@ -47,6 +54,29 @@ class SendError(ConnectionError):
 
 class FatalSendError(SendError):
     """A send rejected by the peer for a non-transient reason — not retried."""
+
+
+class DeltaBaseError(SendError):
+    """The receiver's delta base is missing/desynced (e.g. it restarted).
+
+    Not a transport failure: the stream send path catches it and
+    immediately re-sends the full payload, re-seeding both caches."""
+
+
+class _DeltaStream:
+    """Last-ACKED payload snapshot for one (dest, stream) delta cache."""
+
+    __slots__ = ("data", "ccrc", "fp", "lock")
+
+    def __init__(self) -> None:
+        self.data: Optional[bytes] = None  # full payload the peer holds
+        self.ccrc: Optional[List[int]] = None
+        self.fp: int = 0
+        # Serializes stream sends end-to-end (through the ACK): a delta
+        # only makes sense against the receiver's CURRENT base, and two
+        # in-flight sends on different pooled connections could arrive
+        # reordered.
+        self.lock = asyncio.Lock()
 
 
 class _Conn:
@@ -122,6 +152,14 @@ class TransportClient:
         self._ctl_conn: Optional[_Conn] = None
         self._ctl_lock = asyncio.Lock()
         self._closed = False
+        # Per-(dest, stream) delta caches — the last payload the peer
+        # ACKed on each stream, diffed against the next send so only
+        # changed DELTA_CHUNK_BYTES ranges (+ a bitmap manifest) ship.
+        # Bounded LRU (one full payload snapshot per entry); accessed on
+        # the loop thread only.
+        self._delta_streams: "collections.OrderedDict[str, _DeltaStream]" = (
+            collections.OrderedDict()
+        )
         # Send-pipeline accounting (loop-thread only): wall time of
         # payload frames vs the executor time spent preparing bytes
         # (device→host fetch + checksum) and writing them.  prepare +
@@ -132,6 +170,13 @@ class TransportClient:
             "send_prepare_s": 0.0,
             "send_write_s": 0.0,
             "send_frame_wall_s": 0.0,
+            # Delta-cache accounting: logical payload bytes represented
+            # by stream sends vs bytes actually shipped (changed chunks
+            # + full re-seeds).  1 - wire/logical = the saved fraction.
+            "delta_stream_frames": 0,
+            "delta_full_frames": 0,
+            "delta_logical_bytes": 0,
+            "delta_wire_bytes": 0,
         }
 
     # -- connection management ------------------------------------------------
@@ -197,7 +242,12 @@ class TransportClient:
                 if fut is None or fut.done():
                     continue
                 if msg_type == wire.MSG_ERR:
-                    exc_cls = FatalSendError if header.get("fatal") else SendError
+                    if header.get("fatal"):
+                        exc_cls = FatalSendError
+                    elif header.get("code") == "delta_base":
+                        exc_cls = DeltaBaseError
+                    else:
+                        exc_cls = SendError
                     fut.set_exception(exc_cls(header.get("error", "remote error")))
                 else:
                     fut.set_result(header)
@@ -463,6 +513,8 @@ class TransportClient:
         metadata: Optional[Dict[str, str]] = None,
         crc: Optional[int] = None,
         error: Optional[Dict[str, str]] = None,
+        stream: Optional[str] = None,
+        stream_snapshot: Optional[tuple] = None,
     ) -> str:
         """Push one DATA message with retry policy; returns the ACK result.
 
@@ -470,7 +522,22 @@ class TransportClient:
         the consumer's recv raises :class:`~rayfed_tpu.exceptions.RemoteError`
         (improves on reference ``barriers.py:244-248`` which leaves the
         consumer parked with no diagnosis).
+
+        ``stream``: name a logical stream (stable across rounds, e.g.
+        ``"fedavg/alice"``) to enable the per-peer delta cache: the
+        payload is diffed against the last payload the peer ACKed on the
+        stream and only changed :data:`wire.DELTA_CHUNK_BYTES` ranges
+        ship (plus a bitmap manifest + per-chunk CRCs — wire format v3).
+        ``stream_snapshot``: a precomputed
+        :meth:`snapshot_stream_payload` result, shared across a fan-out
+        so the payload is materialized and hashed once, not once per
+        destination.
         """
+        if stream is not None and error is None:
+            return await self._send_stream(
+                stream, payload_bufs, upstream_seq_id, downstream_seq_id,
+                metadata, snapshot=stream_snapshot,
+            )
         payload_len = wire.payload_nbytes(payload_bufs)
         if payload_len > self._max_message_size:
             raise SendError(
@@ -540,6 +607,187 @@ class TransportClient:
             f"send to {self._dest_party} failed after "
             f"{policy.max_attempts} attempts: {last_exc}"
         )
+
+    @staticmethod
+    def snapshot_stream_payload(payload_bufs: List):
+        """Materialize the payload contiguously + its chunk CRCs.
+
+        Delta diffing needs a stable byte snapshot of the whole payload
+        (lazy shards are forced here), so stream sends trade the
+        overlapped per-shard fetch for the ability to skip unchanged
+        chunks entirely — the right trade when most chunks repeat.
+        Static so a fan-out (``TransportManager.send_many``) computes it
+        ONCE and shares it with every destination's client; run it on a
+        codec/executor thread, not the event loop."""
+        from rayfed_tpu import native
+
+        views = []
+        for buf in payload_bufs:
+            host = buf.produce() if isinstance(buf, wire.LazyBuffer) else buf
+            mv = host if isinstance(host, memoryview) else memoryview(host)
+            if mv.format != "B":
+                mv = mv.cast("B")
+            views.append(mv)
+        full = native.gather_copy(views)
+        return full, wire.chunk_crcs(full)
+
+    @staticmethod
+    def _diff_chunks(full, base, ccrcs, base_ccrcs) -> List[int]:
+        """Indices of DELTA_CHUNK_BYTES chunks that differ from the base.
+
+        CRC inequality proves difference; CRC equality is confirmed with
+        a vectorized byte compare (a colliding chunk must not be
+        silently dropped from the delta)."""
+        import numpy as np
+
+        csz = wire.DELTA_CHUNK_BYTES
+        a = np.frombuffer(full, dtype=np.uint8)
+        b = np.frombuffer(base, dtype=np.uint8)
+        changed = []
+        for i, (c_new, c_old) in enumerate(zip(ccrcs, base_ccrcs)):
+            off = i * csz
+            if c_new != c_old or not np.array_equal(
+                a[off : off + csz], b[off : off + csz]
+            ):
+                changed.append(i)
+        return changed
+
+    async def _send_stream(
+        self, stream: str, payload_bufs: List, upstream_seq_id: str,
+        downstream_seq_id: str, metadata: Optional[Dict[str, str]],
+        snapshot: Optional[tuple] = None,
+    ) -> str:
+        """Stream send with the per-peer delta cache (wire format v3).
+
+        Ships only the chunks that changed since the last payload the
+        peer ACKed on this stream, plus a bitmap manifest; per-chunk
+        CRCs replace the whole-payload checksum on both ends.  A
+        ``delta_base`` reply (receiver restarted / base desynced) falls
+        back to a full payload, re-seeding both caches."""
+        state = self._delta_streams.setdefault(stream, _DeltaStream())
+        self._delta_streams.move_to_end(stream)
+        if len(self._delta_streams) > _MAX_DELTA_STREAMS:
+            # Oldest UNLOCKED stream loses its base (it re-seeds with a
+            # full payload on next use).  A locked state has a send in
+            # flight — evicting it would let a second _DeltaStream for
+            # the same name race the serialization its lock promises.
+            for key in list(self._delta_streams):
+                if len(self._delta_streams) <= _MAX_DELTA_STREAMS:
+                    break
+                if key != stream and not self._delta_streams[key].lock.locked():
+                    del self._delta_streams[key]
+        loop = asyncio.get_running_loop()
+        async with state.lock:
+            if snapshot is not None:
+                full, ccrcs = snapshot
+            else:
+                full, ccrcs = await loop.run_in_executor(
+                    None, self.snapshot_stream_payload, payload_bufs
+                )
+            if len(full) > self._max_message_size:
+                raise SendError(
+                    f"message of {len(full)} bytes exceeds configured max "
+                    f"{self._max_message_size}"
+                )
+            fp = wire.crc_fingerprint(ccrcs)
+            merged_meta = dict(self._metadata)
+            if metadata:
+                merged_meta.update(metadata)
+            csz = wire.DELTA_CHUNK_BYTES
+            nch = len(ccrcs)
+            base_header = {
+                "src": self._src_party,
+                "up": str(upstream_seq_id),
+                "down": str(downstream_seq_id),
+                "meta": merged_meta,
+                "stm": stream,
+                "ccsz": csz,
+            }
+            changed: Optional[List[int]] = None
+            if (
+                state.data is not None
+                and state.ccrc is not None
+                and len(state.data) == len(full)
+            ):
+                changed = await loop.run_in_executor(
+                    None, self._diff_chunks, full, state.data, ccrcs,
+                    state.ccrc,
+                )
+            mv = memoryview(full)
+            # A delta frame only wins when at least one chunk is skipped.
+            force_full = changed is None or len(changed) >= nch
+            policy = self._retry_policy
+            backoff = policy.initial_backoff_s
+            last_exc: Optional[Exception] = None
+            attempt = 0
+            while attempt < max(1, policy.max_attempts):
+                header = dict(base_header)
+                if not force_full:
+                    header["ccrc"] = [ccrcs[i] for i in changed]
+                    header["dlt"] = wire.make_delta_manifest(
+                        len(full),
+                        wire.encode_chunk_bitmap(changed, nch),
+                        state.fp,
+                    )
+                    bufs = [mv[i * csz : (i + 1) * csz] for i in changed]
+                else:
+                    header["ccrc"] = ccrcs
+                    bufs = [mv] if len(full) else []
+                try:
+                    ack = await self._roundtrip(wire.MSG_DATA, header, bufs)
+                except DeltaBaseError:
+                    if force_full:  # full sends can't need a base
+                        raise
+                    logger.debug(
+                        "[%s] stream %r delta base desynced at %s; "
+                        "re-seeding with a full payload",
+                        self._src_party, stream, self._dest_party,
+                    )
+                    force_full = True  # immediate, not a failed attempt
+                    continue
+                except FatalSendError:
+                    raise
+                except asyncio.TimeoutError as e:
+                    raise SendError(
+                        f"send to {self._dest_party} timed out after "
+                        f"{self._timeout_s}s"
+                    ) from e
+                except (SendError, OSError, ConnectionError) as e:
+                    # Outcome unknown (e.g. applied but ACK lost): the
+                    # cache keeps the last-ACKED base — if the peer in
+                    # fact advanced, the next delta's bfp mismatches and
+                    # the delta_base fallback re-seeds.  Retry per
+                    # policy.
+                    last_exc = e
+                    attempt += 1
+                    logger.debug(
+                        "[%s] stream send to %s attempt %d/%d failed: %s",
+                        self._src_party, self._dest_party, attempt,
+                        policy.max_attempts, e,
+                    )
+                    if attempt >= max(1, policy.max_attempts):
+                        break
+                    await asyncio.sleep(backoff)
+                    backoff = min(
+                        backoff * policy.backoff_multiplier,
+                        policy.max_backoff_s,
+                    )
+                    continue
+                # ACKed: the peer now holds `full` — it IS the new base.
+                state.data = full
+                state.ccrc = ccrcs
+                state.fp = fp
+                self.stats["delta_logical_bytes"] += len(full)
+                self.stats["delta_wire_bytes"] += sum(b.nbytes for b in bufs)
+                if force_full:
+                    self.stats["delta_full_frames"] += 1
+                else:
+                    self.stats["delta_stream_frames"] += 1
+                return ack.get("result", "OK")
+            raise SendError(
+                f"stream send to {self._dest_party} failed after "
+                f"{policy.max_attempts} attempts: {last_exc}"
+            )
 
     async def ping(self, timeout_s: float = 1.0, ctl: bool = False) -> bool:
         """Readiness probe with a per-request deadline (no shared-state

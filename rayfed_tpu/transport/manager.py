@@ -359,6 +359,7 @@ class TransportManager:
         data: Any,
         upstream_seq_id: Any,
         downstream_seq_id: Any,
+        stream: Optional[str] = None,
     ) -> LocalRef:
         """Owner-initiated push.  Returns a LocalRef resolving to True/False.
 
@@ -367,9 +368,14 @@ class TransportManager:
         failures into process exit when configured.  Beyond parity, a
         failed producer task or encode also poisons the promised key on
         the consumer (see :meth:`_send_poison`).
+
+        ``stream``: a stable stream name routes the push through the
+        per-peer delta cache (only changed chunks cross the wire — see
+        :meth:`TransportClient._send_stream`).
         """
         return self.send_many(
-            [dest_party], data, upstream_seq_id, downstream_seq_id
+            [dest_party], data, upstream_seq_id, downstream_seq_id,
+            stream=stream,
         )[dest_party]
 
     def send_many(
@@ -378,6 +384,7 @@ class TransportManager:
         data: Any,
         upstream_seq_id: Any,
         downstream_seq_id: Any,
+        stream: Optional[str] = None,
     ) -> Dict[str, LocalRef]:
         """Fan one value out to N parties — encode once, send concurrently.
 
@@ -417,8 +424,16 @@ class TransportManager:
                 streaming = any(
                     isinstance(b, wire.LazyBuffer) for b in bufs
                 ) or nbytes >= wire.SHARD_STREAM_THRESHOLD
+                snapshot = None
+                if stream is not None:
+                    # ONE contiguous snapshot + chunk-CRC pass (codec
+                    # thread), shared by every destination's delta
+                    # cache — the fan-out contract of this method.
+                    snapshot = TransportClient.snapshot_stream_payload(
+                        bufs
+                    )
                 crc = None
-                if not streaming and self._get_client(
+                if stream is None and not streaming and self._get_client(
                     dests[0]
                 ).checksum_enabled:
                     # Small payloads: checksum once on the codec thread,
@@ -440,7 +455,9 @@ class TransportManager:
                     client = self._get_client(p)
                     cf = asyncio.run_coroutine_threadsafe(
                         client.send_data(bufs, str(upstream_seq_id),
-                                         str(downstream_seq_id), crc=crc),
+                                         str(downstream_seq_id), crc=crc,
+                                         stream=stream,
+                                         stream_snapshot=snapshot),
                         self._loop,
                     )
                 except Exception as e:  # pragma: no cover - construction
@@ -548,6 +565,51 @@ class TransportManager:
         # view + skeleton here — no per-leaf intermediate copies.
         return LocalRef(cf).then(_decode, executor=self._codec_pool)
 
+    def recv_stream(
+        self,
+        src_party: str,
+        upstream_seq_id: Any,
+        downstream_seq_id: Any,
+        sink: Any,
+    ) -> None:
+        """Chunk-granular receive: attach ``sink`` to one rendezvous.
+
+        Instead of parking a recv and decoding the complete payload, the
+        sink sees payload bytes AS THEY LAND on the wire
+        (``on_bytes(view, total)`` from transport threads, then
+        ``on_complete(payload)`` / ``on_error(err)``) — the hook the
+        streaming aggregator builds on.  A push that raced in before
+        registration is taken from the mailbox and delivered whole.  Do
+        not also call :meth:`recv` on the same key.
+        """
+        del src_party  # keyed by seq ids, like the mailbox
+        key = (str(upstream_seq_id), str(downstream_seq_id))
+
+        def _on_loop() -> None:
+            msg = self._mailbox.try_take(key)
+            if msg is not None:
+                try:
+                    if msg.error is not None:
+                        sink.on_error(msg.error)
+                    else:
+                        sink.on_complete(msg.payload)
+                except Exception:  # pragma: no cover - sink bug
+                    logger.exception(
+                        "[%s] stream sink failed on mailbox replay",
+                        self._party,
+                    )
+                return
+            self._server.register_chunk_sink(key, sink)
+
+        self._loop.call_soon_threadsafe(_on_loop)
+
+    def cancel_stream(
+        self, upstream_seq_id: Any, downstream_seq_id: Any
+    ) -> None:
+        """Detach a sink registered by :meth:`recv_stream` (timeout paths)."""
+        key = (str(upstream_seq_id), str(downstream_seq_id))
+        self._loop.call_soon_threadsafe(self._server.unregister_chunk_sink, key)
+
     # -- readiness ------------------------------------------------------------
 
     def ping(self, dest_party: str, timeout_s: float = 1.0) -> bool:
@@ -573,8 +635,18 @@ class TransportManager:
         for key in (
             "send_frames", "send_payload_bytes", "send_prepare_s",
             "send_write_s", "send_frame_wall_s",
+            "delta_stream_frames", "delta_full_frames",
+            "delta_logical_bytes", "delta_wire_bytes",
         ):
             stats[key] = sum(c.stats[key] for c in clients)
+        # Fraction of stream-send logical bytes the delta cache kept off
+        # the wire (0.0 when no stream sends happened).
+        logical = stats["delta_logical_bytes"]
+        stats["delta_bytes_saved_frac"] = (
+            (logical - stats["delta_wire_bytes"]) / logical
+            if logical > 0
+            else 0.0
+        )
         stats["send_overlap_saved_s"] = max(
             0.0,
             stats["send_prepare_s"] + stats["send_write_s"]

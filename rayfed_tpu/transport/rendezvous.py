@@ -129,6 +129,34 @@ class Mailbox:
         while len(self._consumed) > _CONSUMED_CACHE:
             self._consumed.popitem(last=False)
 
+    def try_take(self, key: Key) -> Optional[Message]:
+        """Pop the message for ``key`` if it already arrived, else None.
+
+        Non-blocking twin of :meth:`get` for the streaming-receive path:
+        a push that landed before the sink was registered is taken from
+        the mailbox instead (and the key marked consumed as usual)."""
+        entry = self._entries.get(key)
+        if entry is None or entry.message is None:
+            return None
+        self._entries.pop(key, None)
+        self._mark_consumed(key)
+        return entry.message
+
+    def mark_delivered(self, src_party: str, key: Key) -> None:
+        """Record an out-of-band (sink-consumed) delivery of ``key``.
+
+        The payload never entered the mailbox, but the rendezvous must
+        still be remembered as consumed (sender retries after a lost ACK
+        are dups) and the delivery still counts as the party's liveness
+        for the health monitor."""
+        if src_party:
+            self._seen_parties.add(src_party)
+            self._last_put[src_party] = time.monotonic()
+        self._mark_consumed(key)
+        # A parked waiter entry for the same key (conflicting consumers)
+        # is left untouched: recv and recv_stream on one key is a caller
+        # bug, and failing the waiter here would mask it.
+
     async def get(
         self,
         upstream_seq_id: str,

@@ -21,11 +21,13 @@ keep flowing.
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import ssl
+import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from rayfed_tpu.transport import wire
 from rayfed_tpu.transport.rendezvous import Mailbox, Message
@@ -43,6 +45,13 @@ _RAW_READ_BYTES = 4 * 1024 * 1024
 # Headers are small JSON (ids + metadata); a corrupt or hostile peer must
 # not be able to force a multi-GB allocation via the 32-bit hlen field.
 _MAX_HEADER_BYTES = 1 * 1024 * 1024
+# Delta bases retained per server: one full payload per (src, stream) —
+# bounded LRU so a peer cycling stream names can't grow memory unbounded.
+_MAX_DELTA_BASES = 32
+
+
+class _DeltaBaseMissing(Exception):
+    """The delta's base payload isn't cached here (restart/desync)."""
 
 
 class _FrameProtocol(asyncio.BufferedProtocol):
@@ -68,15 +77,36 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._trailer_crc: Optional[int] = None
         self._peer = None
         self._closed = False
+        # Chunk-granular receive hook: when a sink is registered for this
+        # frame's (up, down) key, arriving payload bytes are surfaced to
+        # it incrementally (streaming aggregation consumes them while
+        # later chunks are still on the wire).  Delta frames skip the
+        # incremental feed — their payload is compacted changed chunks,
+        # only meaningful after reconstruction.
+        self._cur_sink = None
 
     # -- protocol callbacks ---------------------------------------------------
 
     def connection_made(self, transport) -> None:
         self._transport = transport
         self._peer = transport.get_extra_info("peername")
+        self._server._protocols.add(self)
 
     def connection_lost(self, exc) -> None:
         self._closed = True
+        self._server._protocols.discard(self)
+        # A sink that was being fed an in-flight payload must hear that
+        # the frame died (the sender will retry on a fresh connection
+        # with a fresh buffer) — otherwise it would keep folding from a
+        # half-filled stale buffer.
+        if self._cur_sink is not None and self._state == "payload":
+            try:
+                self._cur_sink.on_frame_abort(corrupt=False)
+            except Exception:  # pragma: no cover - sink bug
+                logger.exception(
+                    "[%s] chunk sink abort failed", self._server._party
+                )
+            self._cur_sink = None
 
     def get_buffer(self, sizehint: int) -> memoryview:
         if self._state == "payload":
@@ -92,6 +122,17 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             # multi-GB push can't get its sender declared dead just
             # because control pings queue behind the bulk transfer.
             self._server.note_rx_progress(self._header.get("src"), nbytes)
+            if self._cur_sink is not None:
+                try:
+                    self._cur_sink.on_bytes(
+                        self._payload_view, self._got + nbytes
+                    )
+                except Exception:
+                    logger.exception(
+                        "[%s] chunk sink failed (peer=%s)",
+                        self._server._party, self._peer,
+                    )
+                    self._cur_sink = None
         self._got += nbytes
         if self._got < self._need:
             return
@@ -158,6 +199,11 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         self._begin_payload()
 
     def _begin_payload(self) -> None:
+        self._cur_sink = None
+        if self._msg_type == wire.MSG_DATA and self._header.get("dlt") is None:
+            self._cur_sink = self._server.peek_chunk_sink(
+                (str(self._header.get("up")), str(self._header.get("down")))
+            )
         if self._plen == 0:
             self._payload = bytearray(0)
             if self._flags & wire.FLAG_CRC_TRAILER:
@@ -178,6 +224,10 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 # Off-loop bulk read.  Safe w.r.t. buffering: get_buffer
                 # windows are exact, so at this point the transport holds
                 # no payload bytes — they're all still in the kernel.
+                # State is "payload" for the whole drain (no protocol
+                # callbacks fire while paused) so connection_lost's
+                # mid-payload sink-abort applies to raw-read frames too.
+                self._state = "payload"
                 self._transport.pause_reading()
                 self._payload_t0 = time.perf_counter()
                 loop = asyncio.get_running_loop()
@@ -218,6 +268,15 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 # Same liveness signal as the protocol path (note_rx_
                 # progress tolerates this executor-thread caller).
                 self._server.note_rx_progress(src, r)
+                if self._cur_sink is not None:
+                    try:  # sinks are thread-safe (see fl.streaming)
+                        self._cur_sink.on_bytes(view, got)
+                    except Exception:
+                        logger.exception(
+                            "[%s] chunk sink failed (raw read)",
+                            self._server._party,
+                        )
+                        self._cur_sink = None
                 deadline = time.monotonic() + idle_limit
             except (BlockingIOError, InterruptedError):
                 remaining = deadline - time.monotonic()
@@ -263,6 +322,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
     def _reset(self) -> None:
         self._payload = None
         self._payload_view = None
+        self._cur_sink = None
         self._expect("prefix", _PREFIX_SIZE)
 
     # -- frame handling -------------------------------------------------------
@@ -321,6 +381,15 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             self._abort()
             return
 
+        if header.get("ccrc") is not None:
+            # Stream frame (wire v3): per-chunk CRCs verified as the
+            # integrity check — the whole-payload _crc_of re-check is
+            # skipped (it would double-hash multi-GB payloads on the hot
+            # receive path).  Delta frames also reconstruct against the
+            # cached base here.
+            self._handle_stream_data(header, payload, read_seconds)
+            return
+
         expected_crc = header.get("crc")
         if expected_crc is not None:
             from rayfed_tpu import native
@@ -367,6 +436,91 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             actual = _crc_of(payload)
         self._finish_data(header, payload, read_seconds, expected_crc, actual)
 
+    def _handle_stream_data(self, header, payload, read_seconds) -> None:
+        """Verify per-chunk CRCs and (for deltas) rebuild the full payload.
+
+        Both are byte-bound work (CRC pass + a full-payload memcpy for
+        deltas), so large frames run them off-loop with reading paused —
+        same discipline as the whole-payload CRC offload."""
+        server = self._server
+        dlt = header.get("dlt")
+        total = int(dlt["total"]) if dlt else len(payload)
+        if total >= _OFFLOAD_CRC_BYTES:
+            transport = self._transport
+            if transport is not None:
+                transport.pause_reading()
+            loop = asyncio.get_running_loop()
+            fut = loop.run_in_executor(
+                None, _verify_and_apply_stream, server, header, payload
+            )
+
+            def _done(f):
+                try:
+                    final = f.result()
+                    exc = None
+                except Exception as e:
+                    final, exc = None, e
+                finally:
+                    if transport is not None and not self._closed:
+                        transport.resume_reading()
+                self._stream_result(header, read_seconds, final, exc)
+
+            fut.add_done_callback(
+                lambda f: loop.call_soon_threadsafe(_done, f)
+            )
+            return
+        try:
+            final = _verify_and_apply_stream(server, header, payload)
+            exc = None
+        except Exception as e:
+            final, exc = None, e
+        self._stream_result(header, read_seconds, final, exc)
+
+    def _notify_sink_abort(self, header, corrupt: bool) -> None:
+        """A frame that fed a chunk sink failed verification (or died):
+        the sink must know, so already-folded bytes don't silently
+        survive into the aggregate when the sender retries."""
+        sink = self._server.peek_chunk_sink(
+            (str(header.get("up")), str(header.get("down")))
+        )
+        if sink is not None:
+            try:
+                sink.on_frame_abort(corrupt=corrupt)
+            except Exception:  # pragma: no cover - sink bug
+                logger.exception(
+                    "[%s] chunk sink abort failed", self._server._party
+                )
+
+    def _stream_result(self, header, read_seconds, final, exc) -> None:
+        server = self._server
+        if exc is not None:
+            if isinstance(exc, _DeltaBaseMissing):
+                server.stats["receive_delta_base_misses"] = (
+                    server.stats.get("receive_delta_base_misses", 0) + 1
+                )
+                self._reply(
+                    wire.MSG_ERR,
+                    {
+                        "rid": header.get("rid"),
+                        "code": "delta_base",
+                        "error": str(exc),
+                    },
+                )
+                return
+            server.stats["receive_crc_errors"] = (
+                server.stats.get("receive_crc_errors", 0) + 1
+            )
+            self._notify_sink_abort(header, corrupt=True)
+            self._reply(
+                wire.MSG_ERR,
+                {
+                    "rid": header.get("rid"),
+                    "error": f"stream payload verification failed: {exc}",
+                },
+            )
+            return
+        self._finish_data(header, final, read_seconds, None, None)
+
     def _finish_data(
         self, header, payload, read_seconds, expected_crc, actual
     ) -> None:
@@ -375,6 +529,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             server.stats["receive_crc_errors"] = (
                 server.stats.get("receive_crc_errors", 0) + 1
             )
+            self._notify_sink_abort(header, corrupt=True)
             self._reply(
                 wire.MSG_ERR,
                 {
@@ -395,6 +550,28 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         )
         server.stats["receive_op_count"] += 1
         server.stats["receive_bytes"] += len(payload)
+        key = (message.upstream_seq_id, message.downstream_seq_id)
+        sink = server.take_chunk_sink(key)
+        if sink is not None:
+            # Sink-consumed delivery: the payload never parks in the
+            # mailbox (the streaming aggregator already folded it in, or
+            # takes it whole here) — but the rendezvous is still marked
+            # consumed so a sender retry after a lost ACK is deduped,
+            # and the delivery still counts as peer liveness.
+            server._mailbox.mark_delivered(message.src_party, key)
+            try:
+                if message.error is not None:
+                    sink.on_error(message.error)
+                else:
+                    sink.on_complete(message.payload)
+            except Exception:
+                logger.exception(
+                    "[%s] chunk sink completion failed", server._party
+                )
+            self._reply(
+                wire.MSG_ACK, {"rid": header.get("rid"), "result": "OK"}
+            )
+            return
         if server._on_message is not None:
             server._on_message(message)
         server._mailbox.put(message)
@@ -405,6 +582,100 @@ def _crc_of(payload) -> int:
     from rayfed_tpu import native
 
     return native.crc32c(payload)
+
+
+def _verify_and_apply_stream(server: "TransportServer", header, payload):
+    """Verify a stream frame's per-chunk CRCs; rebuild deltas on the base.
+
+    Executor-thread safe (pure byte work + the server's delta-base lock).
+    Returns the FULL logical payload: the frame's own payload for full
+    sends, or a fresh buffer with the changed chunks overlaid on the
+    cached base for delta frames.  The result is stored as the stream's
+    new base — never mutated in place afterwards, so zero-copy decode
+    views of a delivered payload stay valid.
+    """
+    import zlib
+
+    csz = int(header.get("ccsz") or wire.DELTA_CHUNK_BYTES)
+    ccrc = header["ccrc"]
+    dlt = header.get("dlt")
+    src = header.get("src", "?")
+    stm = header.get("stm", "?")
+    mv = memoryview(payload)
+
+    if dlt is None:
+        nch = max(1, -(-len(mv) // csz))
+        if len(ccrc) != nch:
+            raise ValueError(
+                f"{len(ccrc)} chunk CRCs for {nch} payload chunks"
+            )
+        for i, expect in enumerate(ccrc):
+            if zlib.crc32(mv[i * csz : (i + 1) * csz]) != expect:
+                raise ValueError(f"chunk {i} CRC mismatch")
+        server._store_delta_base(
+            src, stm, payload, list(ccrc), wire.crc_fingerprint(ccrc)
+        )
+        return payload
+
+    total = int(dlt["total"])
+    nch = max(1, -(-total // csz))
+    indices = wire.decode_chunk_bitmap(dlt["map"], nch)
+    if len(indices) != len(ccrc):
+        raise ValueError(
+            f"delta bitmap selects {len(indices)} chunks but "
+            f"{len(ccrc)} CRCs were sent"
+        )
+    base = server._get_delta_base(src, stm)
+    if base is None:
+        raise _DeltaBaseMissing(
+            f"no cached base for stream {stm!r} from {src!r}"
+        )
+    if len(base["data"]) != total or base["fp"] != int(dlt["bfp"]):
+        raise _DeltaBaseMissing(
+            f"cached base for stream {stm!r} from {src!r} desynced "
+            f"(restart or lost update)"
+        )
+    if not indices:
+        # Byte-identical resend (the cache's best case): the stored base
+        # IS the payload — no O(model) copy, no re-store (bases are
+        # never mutated in place, so sharing it with the consumer is
+        # safe).
+        if len(mv):
+            raise ValueError("empty delta bitmap with a non-empty payload")
+        server.stats["receive_delta_frames"] = (
+            server.stats.get("receive_delta_frames", 0) + 1
+        )
+        server.stats["receive_delta_bytes_saved"] = (
+            server.stats.get("receive_delta_bytes_saved", 0) + total
+        )
+        return base["data"]
+    new = bytearray(base["data"])
+    new_ccrc = list(base["ccrc"])
+    off = 0
+    for i, expect in zip(indices, ccrc):
+        size = min(csz, total - i * csz)
+        chunk = mv[off : off + size]
+        if len(chunk) != size:
+            raise ValueError("delta payload shorter than its bitmap")
+        if zlib.crc32(chunk) != expect:
+            raise ValueError(f"delta chunk {i} CRC mismatch")
+        new[i * csz : i * csz + size] = chunk
+        new_ccrc[i] = expect
+        off += size
+    if off != len(mv):
+        raise ValueError(
+            f"delta payload has {len(mv) - off} trailing bytes"
+        )
+    server._store_delta_base(
+        src, stm, new, new_ccrc, wire.crc_fingerprint(new_ccrc)
+    )
+    server.stats["receive_delta_frames"] = (
+        server.stats.get("receive_delta_frames", 0) + 1
+    )
+    server.stats["receive_delta_bytes_saved"] = (
+        server.stats.get("receive_delta_bytes_saved", 0) + total - len(mv)
+    )
+    return new
 
 
 class TransportServer:
@@ -435,6 +706,19 @@ class TransportServer:
         # under the GIL, and a (rare) lost += only delays the health
         # monitor's liveness credit by one ping cycle.
         self._rx_progress: Dict[str, int] = {}
+        # Delta bases: (src, stream) → last full payload + its chunk
+        # CRCs + fingerprint.  Touched from the loop thread and the
+        # stream-verify executor jobs, hence the lock; bounded LRU.
+        self._delta_lock = threading.Lock()
+        self._delta_bases: "collections.OrderedDict[Tuple[str, str], Dict]" = (
+            collections.OrderedDict()
+        )
+        # Chunk sinks: (up, down) → streaming consumer (loop thread
+        # only; registered by TransportManager.recv_stream).
+        self._chunk_sinks: Dict[Tuple[str, str], Any] = {}
+        # Live connections (loop thread only): stop() aborts them so
+        # peers see EOF promptly instead of half-open sockets.
+        self._protocols: set = set()
 
     def note_rx_progress(self, party: Optional[str], nbytes: int) -> None:
         if party:
@@ -443,6 +727,50 @@ class TransportServer:
     def receive_progress(self) -> Dict[str, int]:
         """Snapshot of per-party received bytes (incl. in-flight payloads)."""
         return dict(self._rx_progress)
+
+    # -- delta base cache (wire v3 streams) -----------------------------------
+
+    def _get_delta_base(self, src: str, stream: str) -> Optional[Dict]:
+        with self._delta_lock:
+            entry = self._delta_bases.get((src, stream))
+            if entry is not None:
+                self._delta_bases.move_to_end((src, stream))
+            return entry
+
+    def _store_delta_base(
+        self, src: str, stream: str, data, ccrc, fp: int
+    ) -> None:
+        with self._delta_lock:
+            self._delta_bases[(src, stream)] = {
+                "data": data, "ccrc": ccrc, "fp": fp,
+            }
+            self._delta_bases.move_to_end((src, stream))
+            while len(self._delta_bases) > _MAX_DELTA_BASES:
+                self._delta_bases.popitem(last=False)
+
+    # -- chunk sinks (streaming aggregation) ----------------------------------
+
+    def register_chunk_sink(self, key: Tuple[str, str], sink: Any) -> None:
+        """Attach a streaming consumer to one (up, down) rendezvous.
+
+        The sink sees ``on_bytes(view, total)`` as payload bytes land
+        (loop thread or raw-read executor thread — must be thread-safe),
+        then exactly one of ``on_complete(payload)`` / ``on_error(err)``
+        on the loop thread; the frame bypasses the mailbox.  A frame
+        that dies before delivery — connection lost mid-payload, or
+        verification failure — instead emits ``on_frame_abort(corrupt=
+        bool)`` and the sink stays registered for the sender's retry.
+        Loop-thread only (TransportManager schedules it)."""
+        self._chunk_sinks[key] = sink
+
+    def unregister_chunk_sink(self, key: Tuple[str, str]) -> None:
+        self._chunk_sinks.pop(key, None)
+
+    def peek_chunk_sink(self, key: Tuple[str, str]):
+        return self._chunk_sinks.get(key)
+
+    def take_chunk_sink(self, key: Tuple[str, str]):
+        return self._chunk_sinks.pop(key, None)
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -466,3 +794,10 @@ class TransportServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        # Server.close() only stops the LISTENER; established
+        # connections would linger half-open (a peer's in-flight send
+        # then waits out its full ACK deadline instead of seeing EOF
+        # and reconnecting).  Abort them explicitly.
+        for proto in list(self._protocols):
+            proto._abort()
+        self._protocols.clear()

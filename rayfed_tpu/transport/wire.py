@@ -51,8 +51,11 @@ HEADER_SIZE = _HEADER_STRUCT.size
 # (run by test.sh) fails the build when the layout fingerprint drifts
 # without a version bump.  Receivers reject payloads from a NEWER
 # format than they understand instead of misparsing them.
-# History: 1 = unversioned original; 2 = "v" field added to manifest.
-WIRE_FORMAT_VERSION = 2
+# History: 1 = unversioned original; 2 = "v" field added to manifest;
+# 3 = stream/delta frames ("stm"/"ccsz"/"ccrc"/"dlt" header fields:
+# per-chunk CRCs + changed-chunk bitmap manifest for per-peer delta
+# sends — see make_delta_manifest).
+WIRE_FORMAT_VERSION = 3
 
 MSG_DATA = 1
 MSG_ACK = 2
@@ -76,6 +79,12 @@ SHARD_STREAM_THRESHOLD = 8 * 1024 * 1024
 # multi-GB payload buffer alive, and in-place consumers of small
 # host leaves keep working.
 ND_ZERO_COPY_MIN_BYTES = 1 * 1024 * 1024
+
+# Granularity of stream/delta frames (wire v3): per-peer delta caches
+# diff and ship the payload in chunks of this size, and per-chunk CRCs
+# cover exactly these ranges.  Matches the client's WRITE_CHUNK_BYTES so
+# a shipped chunk is one writev unit.
+DELTA_CHUNK_BYTES = 4 * 1024 * 1024
 
 
 def pack_frame(
@@ -610,3 +619,73 @@ def decode_payload(
 
 def payload_nbytes(buffers: List) -> int:
     return sum(len(b) if isinstance(b, (bytes, bytearray)) else b.nbytes for b in buffers)
+
+
+# ---------------------------------------------------------------------------
+# Stream/delta frames (wire format v3)
+# ---------------------------------------------------------------------------
+#
+# A DATA frame sent on a named *stream* carries extra header fields:
+#
+#   stm   stream key (stable across rounds; scopes the delta cache)
+#   ccsz  chunk size the per-chunk CRCs / bitmap refer to
+#   ccrc  list of per-chunk CRC32 (zlib) values, one per TRANSMITTED
+#         chunk in payload order — the receiver verifies each chunk and
+#         skips the whole-payload CRC re-check entirely
+#   dlt   delta manifest (absent on a full send):
+#           total  full logical payload length in bytes
+#           map    hex bitmap, bit i set = chunk i of the logical
+#                  payload is INCLUDED in this frame (it changed)
+#           bfp    fingerprint of the base payload the delta applies to
+#                  (crc32 over the base's packed per-chunk CRC words) —
+#                  a mismatch means the receiver's cached base desynced
+#                  (e.g. peer restart) and it replies
+#                  code="delta_base" so the sender falls back to a
+#                  full payload
+#
+# CRCs here are zlib.crc32 (always C-speed, stdlib) rather than the
+# native CRC32-C path: delta caching must not degrade to a ~MB/s pure-
+# Python checksum when the native codec isn't built.
+
+
+def chunk_crcs(buf, chunk_bytes: int = DELTA_CHUNK_BYTES) -> List[int]:
+    """Per-chunk zlib CRC32 of ``buf`` (last chunk may be short)."""
+    import zlib
+
+    mv = memoryview(buf)
+    if mv.format != "B":
+        mv = mv.cast("B")
+    return [
+        zlib.crc32(mv[off : off + chunk_bytes])
+        for off in range(0, len(mv), chunk_bytes)
+    ] or [zlib.crc32(b"")]
+
+
+def crc_fingerprint(crcs: List[int]) -> int:
+    """One fingerprint of a payload from its per-chunk CRC list.
+
+    Cheap to maintain incrementally (patch the changed chunks' words and
+    re-hash the small list) — both ends use it to prove their delta
+    bases match without re-hashing the multi-GB payload."""
+    import zlib
+
+    return zlib.crc32(b"".join(struct.pack(">I", c) for c in crcs))
+
+
+def encode_chunk_bitmap(indices: List[int], nchunks: int) -> str:
+    """Hex bitmap with bit ``i`` set for every included chunk index."""
+    bits = bytearray((nchunks + 7) // 8)
+    for i in indices:
+        bits[i >> 3] |= 1 << (i & 7)
+    return bits.hex()
+
+
+def decode_chunk_bitmap(hexmap: str, nchunks: int) -> List[int]:
+    bits = bytes.fromhex(hexmap)
+    return [i for i in range(nchunks) if bits[i >> 3] & (1 << (i & 7))]
+
+
+def make_delta_manifest(total: int, bitmap_hex: str, base_fp: int) -> Dict[str, Any]:
+    """The ``dlt`` header field — the single producer of its schema
+    (``tool/check_wire_format.py`` fingerprints it)."""
+    return {"total": int(total), "map": bitmap_hex, "bfp": int(base_fp)}

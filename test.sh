@@ -21,4 +21,9 @@ JAX_PLATFORMS=cpu python tool/check_wire_format.py
 
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 
+# Fast bench smoke: drives the streaming-aggregation + delta-cache
+# pipeline end-to-end over real sockets (small bundles, 4 parties) so a
+# transport/aggregation regression fails CI, not the next bench round.
+JAX_PLATFORMS=cpu python bench.py --smoke
+
 echo "All tests finished."

@@ -87,6 +87,15 @@ def compute_fingerprint() -> str:
     manifest = json.loads(bytes(bufs[1])[:manifest_len])
     del jax  # only imported to force backend parity with the codec
 
+    # Stream/delta framing (wire v3) is part of the cross-party
+    # contract too: the delta bitmap manifest's schema, the stream
+    # header keys, and the chunk granularity the CRCs/bitmap refer to.
+    delta_manifest = wire.make_delta_manifest(
+        total=3 * wire.DELTA_CHUNK_BYTES + 16,
+        bitmap_hex=wire.encode_chunk_bitmap([0, 2], 4),
+        base_fp=wire.crc_fingerprint([1, 2, 3]),
+    )
+
     material = json.dumps(
         {
             "manifest_schema": _schema(manifest),
@@ -96,6 +105,9 @@ def compute_fingerprint() -> str:
             "msg_types": [wire.MSG_DATA, wire.MSG_ACK, wire.MSG_PING,
                           wire.MSG_PONG, wire.MSG_ERR],
             "flags": [wire.FLAG_CRC_TRAILER],
+            "delta_manifest_schema": _schema(delta_manifest),
+            "stream_header_keys": ["stm", "ccsz", "ccrc", "dlt"],
+            "delta_chunk_bytes": wire.DELTA_CHUNK_BYTES,
         },
         sort_keys=True,
     )
