@@ -30,8 +30,9 @@ round of this framework itself (``BENCH_r*.json``), else 1.0.
 Usage: ``python bench.py`` (all configs; first run needs a few
 minutes for compiles).  ``python bench.py --fed-only`` skips the
 accelerator configs; ``--compute-only`` skips the federated ones;
-``--smoke`` runs only the streaming-aggregation round bench at reduced
-scale (the CI gate test.sh drives).
+``--smoke`` runs only the streaming-aggregation and ring-aggregation
+round benches at reduced scale (the CI gate test.sh drives; the ring
+section additionally gates ``coord_bytes_in_frac <= 0.4``).
 """
 
 from __future__ import annotations
@@ -480,6 +481,19 @@ def _run_push_bench(_party: str, result_q) -> None:
     )
 
 
+def _smoke_tree():
+    """The smoke benches' shared synthetic tree (~12 MB bf16 = 3 delta
+    chunks).  ONE producer: the stream-agg and ring smoke sections must
+    aggregate the identical payload shape so their delta caches engage
+    identically and hub-vs-ring numbers compare like for like."""
+    import jax.numpy as jnp
+
+    return {
+        f"l{i}": jnp.arange(1_500_000, dtype=jnp.float32) * 1e-6 + i
+        for i in range(4)
+    }
+
+
 def _run_stream_agg_bench(_party: str, result_q) -> None:
     """ResNet-scale streaming FedAvg round: delta cache + on-the-wire agg.
 
@@ -507,7 +521,6 @@ def _run_stream_agg_bench(_party: str, result_q) -> None:
     """
     import numpy as np
     import jax
-    import jax.numpy as jnp
 
     from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
     from rayfed_tpu.fl import compression as fl_comp
@@ -536,13 +549,7 @@ def _run_stream_agg_bench(_party: str, result_q) -> None:
         m.start()
 
     if smoke:
-        # Small synthetic tree (~12 MB bf16 = 3 delta chunks) — the
-        # fast path for test.sh's bench smoke.
-        tree = {
-            f"l{i}": jnp.arange(1_500_000, dtype=jnp.float32) * 1e-6 + i
-            for i in range(4)
-        }
-        bundle = fl_comp.compress(tree, packed=True)
+        bundle = fl_comp.compress(_smoke_tree(), packed=True)
         rounds = 2
     else:
         from rayfed_tpu.models import resnet
@@ -647,6 +654,161 @@ def _run_stream_agg_bench(_party: str, result_q) -> None:
                 "bundle_mb": bundle_bytes / 1e6,
             },
         )
+    )
+
+
+RINGB_PARTIES = ("alice", "bob", "carol", "dave")
+RINGB_CLUSTER = {
+    p: {"address": f"127.0.0.1:{13110 + i}"}
+    for i, p in enumerate(RINGB_PARTIES)
+}
+
+
+def _run_ring_agg_party(party: str, result_q) -> None:
+    """Ring vs hub FedAvg round over the fed API (4 parties, real wire).
+
+    Same rotating-quarter update shape as the stream-agg bench (so the
+    delta caches engage identically in both topologies), aggregated two
+    ways per child process:
+
+    - **hub**: ``streaming_aggregate`` — contributions funnel into the
+      coordinator (alice), which folds and broadcasts back.
+    - **ring**: ``ring_aggregate`` — chunk-striped reduce-scatter +
+      all-gather around the sorted ring.
+
+    Each party reports its wall time and its server-side ingress bytes
+    for both phases.  The parent derives:
+
+    - ``ring_agg_GBps``: logical contribution bytes over the ring
+      round (N·|bundle|·rounds / wall).
+    - ``ring_vs_coord_speedup``: hub wall / ring wall.  NB loopback
+      under-rewards the ring — every "link" shares one host NIC/CPU,
+      so the hub's per-node serialization (the thing the ring removes)
+      is partially hidden; on real cross-silo links the hub coordinator
+      is the bottleneck the speedup tracks.
+    - ``coord_bytes_in_frac``: the coordinator's share of the round's
+      TOTAL cross-party ingress bytes in ring mode — the de-bottleneck
+      invariant.  Hub topology pins this at ~0.5 regardless of N (the
+      coordinator receives half of all bytes the cluster receives);
+      the ring spreads it to ~1/N (0.25 at N=4).  Gated ≤ 0.4 by
+      test.sh's smoke.
+    """
+    import numpy as np
+    import jax
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.fl.ring import ring_aggregate
+    from rayfed_tpu.fl.streaming import streaming_aggregate
+    from rayfed_tpu.runtime import get_runtime
+
+    smoke = bool(os.environ.get("RAYFED_BENCH_SMOKE"))
+    fed.init(address="local", cluster=RINGB_CLUSTER, party=party)
+
+    if smoke:
+        tree = _smoke_tree()
+        rounds = 2
+        chunk_elems = 1 << 19  # 1 MB bf16 blocks: 12 blocks / 4 stripes
+    else:
+        from rayfed_tpu.models import resnet
+
+        cfg = resnet.resnet18(num_classes=10)
+        tree = resnet.init_resnet(jax.random.PRNGKey(0), cfg)
+        rounds = 3
+        chunk_elems = None  # canonical 4 MB grid (~6 blocks)
+
+    bundle = fl_comp.compress(tree, packed=True)
+    base32 = np.asarray(bundle.buf).astype(np.float32)
+    n_elems = base32.size
+    bundle_bytes = np.asarray(bundle.buf).nbytes
+    wire_dt = np.asarray(bundle.buf).dtype
+
+    def contribution(party_idx: int, r: int) -> "fl_comp.PackedTree":
+        arr = base32.copy()
+        q = n_elems // 4
+        lo = (r % 4) * q
+        arr[lo : lo + q] += 1e-3 * (party_idx + 1) * (r + 1)
+        return fl_comp.PackedTree(
+            arr.astype(wire_dt), bundle.passthrough, bundle.spec
+        )
+
+    produce = fed.remote(contribution)
+
+    def do_rounds(mode: str, r0: int, nrounds: int) -> float:
+        t0 = time.perf_counter()
+        for r in range(r0, r0 + nrounds):
+            objs = [
+                produce.party(p).remote(i, r)
+                for i, p in enumerate(RINGB_PARTIES)
+            ]
+            if mode == "ring":
+                out = ring_aggregate(
+                    objs, stream="rg", chunk_elems=chunk_elems
+                )
+            else:
+                out = streaming_aggregate(
+                    objs, stream="hub", coordinator=RINGB_PARTIES[0]
+                )
+            np.asarray(out.buf[:64])  # touch: the round really landed
+        return time.perf_counter() - t0
+
+    def ingress() -> int:
+        return int(get_runtime().transport.get_stats()["receive_bytes"])
+
+    report = {"bundle_mb": bundle_bytes / 1e6}
+    for mode in ("hub", "ring"):
+        do_rounds(mode, 0, 1)  # warmup: compiles + seeds delta caches
+        in0 = ingress()
+        report[f"{mode}_s"] = do_rounds(mode, 1, rounds)
+        report[f"{mode}_in"] = ingress() - in0
+    report["rounds"] = rounds
+    if result_q is not None:
+        result_q.put((party, report))
+    fed.shutdown()
+
+
+def _ring_bench_metrics(res: dict) -> dict:
+    """Reduce the per-party ring-bench reports to the headline metrics."""
+    coord = RINGB_PARTIES[0]
+    rounds = res[coord]["rounds"]
+    bundle = res[coord]["bundle_mb"] * 1e6
+    hub_wall = sum(v["hub_s"] for v in res.values()) / len(res)
+    ring_wall = sum(v["ring_s"] for v in res.values()) / len(res)
+    total_ring_in = sum(v["ring_in"] for v in res.values())
+    total_hub_in = sum(v["hub_in"] for v in res.values())
+    return {
+        "ring_agg_GBps": round(
+            len(res) * bundle * rounds / ring_wall / 1e9, 3
+        ),
+        "ring_vs_coord_speedup": round(hub_wall / ring_wall, 3),
+        "coord_bytes_in_frac": round(
+            res[coord]["ring_in"] / total_ring_in, 3
+        ),
+        "coord_bytes_in_frac_hub": round(
+            res[coord]["hub_in"] / total_hub_in, 3
+        ),
+        "ring_coord_ingress_vs_hub": round(
+            res[coord]["ring_in"] / max(1, res[coord]["hub_in"]), 3
+        ),
+        "ring_round_ms": round(ring_wall / rounds * 1e3, 1),
+        "hub_round_ms": round(hub_wall / rounds * 1e3, 1),
+        "ring_bundle_mb": round(bundle / 1e6, 1),
+    }
+
+
+def _fill_ring_extra(extra: dict, res: dict) -> None:
+    m = _ring_bench_metrics(res)
+    extra.update(m)
+    _log(
+        f"  ring-agg: {m['ring_agg_GBps']:.3f} GB/s logical through the "
+        f"ring round; coordinator takes {m['coord_bytes_in_frac']:.0%} "
+        f"of cluster ingress (hub: {m['coord_bytes_in_frac_hub']:.0%}), "
+        f"{m['ring_coord_ingress_vs_hub']:.2f}x its hub ingress bytes; "
+        f"round {m['ring_round_ms']:.0f} ms vs hub "
+        f"{m['hub_round_ms']:.0f} ms "
+        f"(speedup {m['ring_vs_coord_speedup']:.2f}x — loopback "
+        f"under-rewards the ring; the ingress fraction is the "
+        f"topology invariant)"
     )
 
 
@@ -2017,6 +2179,13 @@ def main() -> None:
             _log("streaming-aggregation smoke (small bundles, 4 parties)...")
             s = _one_child("_run_stream_agg_bench", ndev=1, timeout=420)
             _fill_stream_extra(extra, s)
+        with _section(extra, "ring_agg"):
+            _log("ring-aggregation smoke (4-party ring vs hub)...")
+            rres = _multi_party(
+                "_run_ring_agg_party", parties=RINGB_PARTIES, ndev=1,
+                timeout=420,
+            )
+            _fill_ring_extra(extra, rres)
         record = {
             "metric": "cross_party_stream_agg_GBps",
             "value": extra.get("cross_party_stream_agg_GBps", 0.0),
@@ -2026,7 +2195,17 @@ def main() -> None:
         }
         record.update(extra)
         print(json.dumps(record), flush=True)
-        if "stream_agg_error" in extra:
+        if "stream_agg_error" in extra or "ring_agg_error" in extra:
+            raise SystemExit(1)
+        # CI gate (test.sh): the ring must actually de-bottleneck the
+        # coordinator — its share of cluster ingress bytes at or near
+        # 1/N, never above 0.4 (the hub pins ~0.5 regardless of N).
+        frac = extra.get("coord_bytes_in_frac")
+        if frac is None or frac > 0.4:
+            _log(
+                f"ring smoke gate FAILED: coord_bytes_in_frac={frac} "
+                f"(must be <= 0.4)"
+            )
             raise SystemExit(1)
         return
 
@@ -2172,6 +2351,16 @@ def main() -> None:
                  "delta cache, 4 parties)...")
             s = _one_child("_run_stream_agg_bench", ndev=1, timeout=600)
             _fill_stream_extra(extra, s)
+            _settle()
+
+        with _section(extra, "ring_agg"):
+            _log("ring FedAvg aggregation (ResNet-18 packed rounds, "
+                 "4-party ring vs hub)...")
+            rres = _multi_party(
+                "_run_ring_agg_party", parties=RINGB_PARTIES, ndev=1,
+                timeout=900,
+            )
+            _fill_ring_extra(extra, rres)
             _settle()
 
         with _section(extra, "lora_2party"):

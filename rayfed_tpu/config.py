@@ -25,12 +25,40 @@ class RetryPolicy:
     Defaults mirror the reference's gRPC service config
     (``fed/_private/grpc_options.py:17-23``): 5 attempts, 5s initial
     backoff, 30s max, ×2 multiplier, retry on transport unavailability.
+
+    ``jitter`` (default on) decorrelates the delays: N parties that all
+    hit the same dead peer otherwise retry in lockstep — every backoff
+    wave lands the reconnect storm at the same instant the peer comes
+    back.  Uses the "decorrelated jitter" recurrence
+    ``sleep = min(cap, U(base, 3·prev))`` rather than plain
+    ``exp × U(0,1)``: successive delays still grow toward the cap, but
+    two clients' sequences diverge after the first draw.
     """
 
     max_attempts: int = 5
     initial_backoff_s: float = 5.0
     max_backoff_s: float = 30.0
     backoff_multiplier: float = 2.0
+    jitter: bool = True
+
+    def next_backoff(
+        self, prev: Optional[float], rng: Optional[Any] = None
+    ) -> float:
+        """Delay before the next attempt given the previous delay
+        (``None`` for the first retry).  With ``jitter=False`` this is
+        the exact legacy exponential sequence."""
+        if not self.jitter:
+            if prev is None:
+                return self.initial_backoff_s
+            return min(
+                prev * self.backoff_multiplier, self.max_backoff_s
+            )
+        import random
+
+        rng = rng if rng is not None else random
+        lo = self.initial_backoff_s
+        hi = max(lo, 3.0 * (prev if prev is not None else lo))
+        return min(self.max_backoff_s, rng.uniform(lo, hi))
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RetryPolicy":
@@ -54,6 +82,7 @@ class RetryPolicy:
             backoff_multiplier=float(
                 d.get("backoffMultiplier", d.get("backoff_multiplier", 2.0))
             ),
+            jitter=bool(d.get("retryJitter", d.get("jitter", True))),
         )
 
 

@@ -415,9 +415,35 @@ class MultiHostTransport:
             src_party, upstream_seq_id, downstream_seq_id, sink
         )
 
+    def recv_stream_many(self, entries):
+        """Batch chunk-sink registration — leader only, like
+        :meth:`recv_stream` (same non-leader caveat)."""
+        if self._inner is None:
+            raise NotImplementedError(
+                "streaming aggregation is not supported on non-leader "
+                "processes of a multi-host party — aggregate with "
+                "fl.aggregate there instead"
+            )
+        return self._inner.recv_stream_many(entries)
+
     def cancel_stream(self, upstream_seq_id, downstream_seq_id):
         if self._inner is not None:
             self._inner.cancel_stream(upstream_seq_id, downstream_seq_id)
+
+    def _send_poison(self, dest_party, upstream_seq_id, downstream_seq_id,
+                     exc):
+        """Poison a promised rendezvous key on the consumer (see
+        :meth:`TransportManager._send_poison`).  Leaders delegate to the
+        real wire — without this, a multi-host leader's aggregation
+        aborts (ring poison cascade, streaming result poison) would
+        silently no-op and leave every peer parked until its backstop.
+        Non-leaders resolve ``True`` like :meth:`send`: the leader's
+        identical program delivers the real poison."""
+        if self._inner is not None:
+            return self._inner._send_poison(
+                dest_party, upstream_seq_id, downstream_seq_id, exc
+            )
+        return LocalRef.from_value(True)
 
     def ping(self, dest_party: str, timeout_s: float = 1.0) -> bool:
         if self._inner is not None:

@@ -33,7 +33,12 @@ from rayfed_tpu.fl.fedavg import (
     tree_average,
     tree_weighted_sum,
 )
-from rayfed_tpu.fl.streaming import StreamingAggregator, streaming_aggregate
+from rayfed_tpu.fl.ring import RingRoundError, ring_aggregate
+from rayfed_tpu.fl.streaming import (
+    StreamingAggregator,
+    StripeAggregator,
+    streaming_aggregate,
+)
 from rayfed_tpu.fl.fedopt import (
     fedprox_loss,
     server_adam,
@@ -54,7 +59,10 @@ __all__ = [
     "aggregate",
     "packed_weighted_sum",
     "streaming_aggregate",
+    "ring_aggregate",
+    "RingRoundError",
     "StreamingAggregator",
+    "StripeAggregator",
     "ErrorFeedback",
     "tree_average",
     "tree_weighted_sum",

@@ -97,6 +97,72 @@ def _packed_reduce_jit(out_dtype_name: str):
     return _reduce
 
 
+def packed_block_grid(total_elems: int, chunk_elems: Optional[int] = None) -> int:
+    """Number of blocks in the packed buffer's canonical chunk grid.
+
+    The grid every fold schedule refers to: ``chunk_elems`` wire
+    elements per block (default
+    :data:`rayfed_tpu.fl.streaming.DEFAULT_CHUNK_ELEMS`, the transport's
+    4 MB bf16 chunk), last block short.  Exported so the streaming
+    aggregator, the ring topology (:mod:`rayfed_tpu.fl.ring`) and tests
+    all derive the identical grid from the identical constant.
+    """
+    if chunk_elems is None:
+        from rayfed_tpu.fl.streaming import DEFAULT_CHUNK_ELEMS
+
+        chunk_elems = DEFAULT_CHUNK_ELEMS
+    if total_elems < 0:
+        raise ValueError(f"total_elems must be >= 0, got {total_elems}")
+    return max(1, -(-total_elems // int(chunk_elems)))
+
+
+def packed_stripe_schedule(
+    nblocks: int, n_stripes: int
+) -> List[List[int]]:
+    """Round-robin assignment of the chunk grid to ``n_stripes`` stripes.
+
+    Block ``b`` belongs to stripe ``b % n_stripes``; stripe ``k`` of a
+    sorted party ring is owned by the ring's ``k``-th party.  This is
+    THE canonical stripe layout (documented in
+    ``docs/source/ring_topology.rst``): both the ring reduce-scatter's
+    senders and its stripe owners derive it independently, so the
+    mapping is part of the cross-party contract, like the wire format.
+    """
+    if n_stripes < 1:
+        raise ValueError(f"n_stripes must be >= 1, got {n_stripes}")
+    return [
+        list(range(k, nblocks, n_stripes)) for k in range(n_stripes)
+    ]
+
+
+@functools.lru_cache(maxsize=None)
+def _stripe_finalize_jit(total_elems: int, out_dtype_name: str):
+    @jax.jit
+    def _finish(acc, total_w):
+        return (acc[:total_elems] / total_w).astype(
+            jnp.dtype(out_dtype_name)
+        )
+
+    return _finish
+
+
+def finalize_packed_stripe(acc, total_w: float, total_elems: int, out_dtype):
+    """THE packed-aggregate finalize: ``(acc[:n] / total_w).astype(out)``.
+
+    One fused divide + cast over an f32 accumulator holding
+    ``sum_i(w_i * x_i)`` — the second half of the (weight·payload,
+    weight) pair every fold path carries.  Shared by the one-shot
+    reduce, the streaming aggregator, and each ring stripe owner: the
+    operation is elementwise, so finalizing a stripe's compacted
+    accumulator produces exactly the bytes the whole-buffer finalize
+    would produce at those element positions — the keystone of
+    ring/coordinator bit-identity.
+    """
+    return _stripe_finalize_jit(
+        int(total_elems), np.dtype(out_dtype).name
+    )(acc, np.float32(total_w))
+
+
 def _reduce_passthrough(passthroughs, weights, total):
     """Average the non-float (passthrough) leaf tuples of N PackedTrees
     with :func:`tree_average`'s per-leaf semantics.  Shared by the

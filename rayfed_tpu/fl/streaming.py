@@ -84,16 +84,9 @@ def _accum_kernel(chunk_elems: int, acc_dtype: str, wire_dtype: str):
     return _apply
 
 
-@functools.lru_cache(maxsize=None)
-def _finalize_kernel(total_elems: int, acc_dtype: str, out_dtype: str):
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def _finish(acc, total_w):
-        return (acc[:total_elems] / total_w).astype(jnp.dtype(out_dtype))
-
-    return _finish
+# Finalize (divide + cast) is shared with the one-shot path and the
+# ring stripe owners: rayfed_tpu.fl.fedavg.finalize_packed_stripe is
+# the single producer of the output bytes.
 
 
 class _Stream:
@@ -102,7 +95,7 @@ class _Stream:
     __slots__ = (
         "payload", "avail_bytes", "complete", "local_tree", "elems_array",
         "data_start", "data_nbytes", "dtype", "applied_blocks",
-        "t_complete", "notified_bytes",
+        "t_complete", "notified_bytes", "manifest",
     )
 
     def __init__(self) -> None:
@@ -117,6 +110,7 @@ class _Stream:
         self.applied_blocks = 0
         self.t_complete = 0.0
         self.notified_bytes = 0
+        self.manifest: Optional[Dict[str, Any]] = None  # parsed payload manifest
 
 
 class _StreamSink:
@@ -233,12 +227,16 @@ class StreamingAggregator:
                 )
             )
             return
+        self._attach_local(index, np.asarray(packed_tree.buf).reshape(-1),
+                           tree=packed_tree)
+
+    def _attach_local(self, index: int, arr: np.ndarray, tree=None) -> None:
+        """Bind a wire-hop-free contribution (a host element array)."""
         self._ensure_worker()
-        arr = np.asarray(packed_tree.buf).reshape(-1)
         now = time.perf_counter()
         with self._cond:
             s = self._streams[index]
-            s.local_tree = packed_tree
+            s.local_tree = tree
             s.elems_array = arr
             s.dtype = arr.dtype
             s.data_start = 0
@@ -396,6 +394,7 @@ class StreamingAggregator:
         if s.avail_bytes < 4 + mlen:
             return False
         manifest = json.loads(bytes(mv[4 : 4 + mlen]))
+        s.manifest = manifest  # sideband consumers (StripeAggregator)
         leaves = manifest["leaves"]
         if not leaves or leaves[0]["k"] not in ("nd", "nds"):
             raise ValueError(
@@ -432,8 +431,12 @@ class StreamingAggregator:
                 f"buffer; split the tree into multiple packed buffers"
             )
         self._wire_dtype = s.dtype
-        self._nblocks = max(
-            1, -(-self._total_elems // self._chunk_elems)
+        # THE canonical grid — shared with the ring stripe schedule so
+        # the fold blocks and the stripe blocks are the same blocks.
+        from rayfed_tpu.fl.fedavg import packed_block_grid
+
+        self._nblocks = packed_block_grid(
+            self._total_elems, self._chunk_elems
         )
         self._acc = jnp.zeros(
             self._nblocks * self._chunk_elems, jnp.float32
@@ -565,35 +568,8 @@ class StreamingAggregator:
                 with self._cond:
                     s.applied_blocks = hi
 
-        # Finalize: divide + cast once, rebuild the PackedTree around
-        # the aggregated buffer (spec/passthrough from one template
-        # contribution — they are structural, identical across parties).
         t0 = time.perf_counter()
-        out_dt = self._out_dtype or self._wire_dtype
-        finish = _finalize_kernel(
-            self._total_elems, "float32", str(out_dt)
-        )
-        out_buf = finish(self._acc, np.float32(self._total_w))
-        out_buf.block_until_ready()
-        template = self._template_tree()
-        from rayfed_tpu.fl.compression import PackedTree, PackSpec
-
-        passthrough = template.passthrough
-        if passthrough:
-            # Non-float leaves get the same per-leaf averaging the
-            # one-shot path applies (every payload is still retained as
-            # a zero-copy view, so decoding the skeletons is cheap).
-            from rayfed_tpu.fl.fedavg import _reduce_passthrough
-
-            passthrough = _reduce_passthrough(
-                [t.passthrough for t in map(self._tree_of, self._streams)],
-                self._weights_arg,
-                self._total_w,
-            )
-        spec = template.spec
-        if str(out_dt) != spec.wire_dtype:
-            spec = PackSpec(spec.entries, spec.treedef, np.dtype(out_dt).name)
-        result = PackedTree(out_buf, passthrough, spec)
+        result = self._finalize()
         self._busy_s += time.perf_counter() - t0
         self._t_done = time.perf_counter()
         if not self._t_all_complete:
@@ -612,6 +588,38 @@ class StreamingAggregator:
             self._result = result
             self._done = True
             self._cond.notify_all()
+
+    def _finalize(self):
+        """Divide + cast once, rebuild the PackedTree around the
+        aggregated buffer (spec/passthrough from one template
+        contribution — they are structural, identical across parties).
+        Runs on the worker after every block folded; overridden by
+        :class:`StripeAggregator` to emit a bare stripe buffer."""
+        from rayfed_tpu.fl.compression import PackedTree, PackSpec
+        from rayfed_tpu.fl.fedavg import finalize_packed_stripe
+
+        out_dt = self._out_dtype or self._wire_dtype
+        out_buf = finalize_packed_stripe(
+            self._acc, self._total_w, self._total_elems, out_dt
+        )
+        out_buf.block_until_ready()
+        template = self._template_tree()
+        passthrough = template.passthrough
+        if passthrough:
+            # Non-float leaves get the same per-leaf averaging the
+            # one-shot path applies (every payload is still retained as
+            # a zero-copy view, so decoding the skeletons is cheap).
+            from rayfed_tpu.fl.fedavg import _reduce_passthrough
+
+            passthrough = _reduce_passthrough(
+                [t.passthrough for t in map(self._tree_of, self._streams)],
+                self._weights_arg,
+                self._total_w,
+            )
+        spec = template.spec
+        if str(out_dt) != spec.wire_dtype:
+            spec = PackSpec(spec.entries, spec.treedef, np.dtype(out_dt).name)
+        return PackedTree(out_buf, passthrough, spec)
 
     def _tree_of(self, s: _Stream):
         from rayfed_tpu.fl.compression import PackedTree
@@ -634,6 +642,126 @@ class StreamingAggregator:
             if s.local_tree is not None:
                 return s.local_tree
         return self._tree_of(self._streams[0])
+
+
+class StripeAggregator(StreamingAggregator):
+    """Fold one *stripe* of the packed chunk grid as its bytes arrive.
+
+    The ring topology (:mod:`rayfed_tpu.fl.ring`) stripes the packed
+    buffer's chunk grid across the sorted party ring; each stripe owner
+    runs one of these over the compacted stripe payloads its peers send
+    (leaf 0 of each payload is the stripe's chunks back to back, in
+    ascending block order).  Everything else — the thread-safe sinks,
+    the frame-abort semantics, and crucially the **party-order-per-
+    block fold schedule** — is inherited from
+    :class:`StreamingAggregator`, and the finalize is the shared
+    :func:`rayfed_tpu.fl.fedavg.finalize_packed_stripe`.  Because both
+    the fold chain and the divide+cast are elementwise, the stripe
+    result is byte-identical to the corresponding element range of the
+    whole-buffer aggregate: assembling the N stripes reproduces
+    ``packed_weighted_sum`` exactly.
+
+    ``expect_elems``: the stripe's element count, known to the owner
+    from the canonical schedule — a mis-wired payload fails fast with a
+    layout error instead of folding into the wrong offsets.
+    ``meta_check``: called with the payload's ``rsm`` manifest string
+    (its last — ``py`` — leaf) BEFORE any of that stream's blocks fold;
+    the ring passes its schedule cross-check here, so two parties
+    disagreeing on the chunk grid abort loudly instead of folding
+    equal-sized-but-differently-composed stripes into wrong offsets.
+    """
+
+    def __init__(
+        self,
+        n_sources: int,
+        weights: Optional[Sequence[float]] = None,
+        allowed: Optional[Dict[str, Any]] = None,
+        chunk_elems: int = DEFAULT_CHUNK_ELEMS,
+        out_dtype: Any = None,
+        expect_elems: Optional[int] = None,
+        label: str = "stripe",
+        meta_check: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            n_sources, weights=weights, allowed=allowed,
+            chunk_elems=chunk_elems, out_dtype=out_dtype,
+        )
+        self._expect_elems = (
+            None if expect_elems is None else int(expect_elems)
+        )
+        self._label = label
+        self._meta_check = meta_check
+
+    def _parse_layout(self, s: _Stream) -> bool:
+        already = s.data_start >= 0
+        if not super()._parse_layout(s):
+            return False
+        if self._meta_check is not None and not already and s.manifest is not None:
+            # Wire payloads only (the owner's own stripe needs no
+            # manifest; s.manifest is the base parse's — one decode per
+            # stream); runs once, before any of its blocks fold.
+            last = s.manifest["leaves"][-1]
+            if last.get("k") != "py" or not isinstance(last.get("v"), str):
+                raise ValueError(
+                    f"{self._label}: stripe payload is missing its "
+                    f"'rsm' manifest leaf"
+                )
+            self._meta_check(last["v"])
+        return True
+
+    def add_local(self, index: int, stripe: Any) -> None:
+        """Feed the owner's own stripe (a 1-D wire-dtype host array)."""
+        arr = np.asarray(stripe).reshape(-1)
+        if (
+            self._expect_elems is not None
+            and arr.size != self._expect_elems
+        ):
+            self.fail(
+                ValueError(
+                    f"{self._label}: local stripe has {arr.size} "
+                    f"elements, schedule expects {self._expect_elems}"
+                )
+            )
+            return
+        self._attach_local(index, arr)
+
+    def _init_acc(self, s: _Stream) -> None:
+        super()._init_acc(s)
+        if (
+            self._expect_elems is not None
+            and self._total_elems != self._expect_elems
+        ):
+            raise ValueError(
+                f"{self._label}: contribution carries "
+                f"{self._total_elems} elements, schedule expects "
+                f"{self._expect_elems} — ring peers disagree on the "
+                f"stripe layout"
+            )
+
+    def payload_value(self, index: int) -> Any:
+        """Decode the full payload of source ``index`` (the stripe dict
+        with its sideband fields) — retained as a zero-copy view, so
+        this is cheap.  None for the owner's own (local) source."""
+        from rayfed_tpu.transport import wire as wire_mod
+
+        s = self._streams[index]
+        if s.payload is None:
+            return None
+        return wire_mod.decode_payload(
+            s.payload, allowed=self._allowed, zero_copy=True
+        )
+
+    def _finalize(self):
+        """Bare stripe buffer in the output dtype (host array): the
+        assembly step scatters it back onto the chunk grid."""
+        from rayfed_tpu.fl.fedavg import finalize_packed_stripe
+
+        out_dt = self._out_dtype or self._wire_dtype
+        out_buf = finalize_packed_stripe(
+            self._acc, self._total_w, self._total_elems, out_dt
+        )
+        out_buf.block_until_ready()
+        return np.asarray(out_buf)
 
 
 def streaming_aggregate(
@@ -713,6 +841,7 @@ def streaming_aggregate(
         out_dtype=out_dtype,
     )
     pending_cancels: List[tuple] = []
+    sink_entries: List[tuple] = []
     for i, obj in enumerate(objs):
         if obj.get_party() == me:
             local_ref = obj.get_local_ref()
@@ -726,11 +855,15 @@ def streaming_aggregate(
 
             local_ref.add_done_callback(_feed)
         else:
-            runtime.transport.recv_stream(
-                obj.get_party(), obj.get_fed_task_id(), contrib_id,
-                agg.sink(i),
+            sink_entries.append(
+                (obj.get_party(), obj.get_fed_task_id(), contrib_id,
+                 agg.sink(i))
             )
             pending_cancels.append((obj.get_fed_task_id(), contrib_id))
+    if sink_entries:
+        # One loop hop registers every contribution sink (and enrolls
+        # their source parties with the health monitor's fail-fast).
+        runtime.transport.recv_stream_many(sink_entries)
     others = [p for p in parties if p != me]
     try:
         result = agg.result(timeout=backstop)

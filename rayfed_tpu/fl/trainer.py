@@ -18,11 +18,14 @@ seq-ids re-align the rendezvous, SURVEY §5.4's resume story).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Optional, Sequence
 
 from rayfed_tpu.fl.compression import ErrorFeedback, compress, decompress
 from rayfed_tpu.fl.fedavg import aggregate
 from rayfed_tpu.fl.fedopt import ServerOptimizer
+
+logger = logging.getLogger(__name__)
 
 
 def sample_parties(
@@ -61,6 +64,8 @@ def run_fedavg_rounds(
     streaming_agg: bool = False,
     error_feedback: bool = False,
     wire_dtype: Any = None,
+    mode: str = "coordinator",
+    coordinator: Optional[str] = None,
 ) -> Any:
     """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
 
@@ -118,6 +123,30 @@ def run_fedavg_rounds(
     - ``wire_dtype``: the compressed wire dtype for the driver's
       outgoing pushes (default bf16).  Pair an aggressive choice (e.g.
       ``jnp.float8_e4m3fn``) with ``error_feedback=True``.
+    - ``mode``: the aggregation wire topology.  ``"coordinator"`` (the
+      default) funnels contributions through one party (hub-and-spoke;
+      with ``streaming_agg`` they fold as they arrive).  ``"ring"``
+      replaces the hub with a chunk-striped **reduce-scatter +
+      all-gather** over the sorted party ring
+      (:func:`rayfed_tpu.fl.ring.ring_aggregate`): per-party traffic is
+      ``~2·|model|`` independent of party count, and the result is
+      byte-identical to the coordinator path.  Requires
+      ``compress_wire`` + ``packed_wire`` (the striped unit is the
+      packed buffer); full participation only (``sample`` churns ring
+      membership, which would re-stripe the grid and thrash every delta
+      cache — use the coordinator topology for sampled rounds); custom
+      ``aggregator`` reducers need the raw values and stay
+      coordinator-only.  When a ring round aborts mid-flight (peer
+      death, poisoned hop), EVERY controller sees the abort (poison
+      cascade + commit ring) and the driver re-aggregates the same
+      round's updates over the coordinator topology — the round's
+      training work is never lost.
+    - ``coordinator``: which party anchors coordinator-mode rounds and
+      ring fallbacks (default: the canonically-first — ``min`` — party).
+      Exposed mainly for tests and for deployments whose first party is
+      bandwidth-poor; keep it STABLE across a training run, because
+      every delta-stream cache is keyed by destination and a moving
+      coordinator re-seeds full payloads on every peer it moves to.
 
     Without a server optimizer the rounds **pipeline**: the averaged
     model flows into the next round as a lazy ``FedObject`` (no
@@ -168,6 +197,42 @@ def run_fedavg_rounds(
             "packed_wire=True (the residual is carried on the packed "
             "wire buffer)"
         )
+    if mode not in ("coordinator", "ring"):
+        raise ValueError(
+            f"unknown mode {mode!r}: expected 'coordinator' or 'ring'"
+        )
+    if mode == "ring":
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "mode='ring' requires compress_wire=True and "
+                "packed_wire=True (the striped unit is the packed wire "
+                "buffer)"
+            )
+        if aggregator is not None:
+            raise ValueError(
+                "mode='ring' and aggregator are mutually exclusive (a "
+                "custom reducer needs the raw per-party values at one "
+                "place)"
+            )
+        if sample is not None and sample != len(trainers):
+            raise ValueError(
+                "mode='ring' requires full participation: sampling "
+                "churns ring membership, re-striping the chunk grid "
+                "and thrashing the per-peer delta caches every round — "
+                "use mode='coordinator' for sampled rounds"
+            )
+        if streaming_agg:
+            raise ValueError(
+                "mode='ring' and streaming_agg are mutually exclusive: "
+                "the ring replaces the hub topology streaming_agg "
+                "folds on (the ring's fallback path streams on its "
+                "own) — drop streaming_agg or use mode='coordinator'"
+            )
+    if coordinator is not None and coordinator not in trainers:
+        raise ValueError(
+            f"coordinator {coordinator!r} is not a training party "
+            f"({sorted(trainers)})"
+        )
 
     from rayfed_tpu.fed_object import FedObject
 
@@ -195,8 +260,19 @@ def run_fedavg_rounds(
         and aggregator is None  # a reducer needs the raw values
         and not streaming_agg  # streaming materializes at the reducer
         and not error_feedback  # the residual needs the driver's tree
+        and mode == "coordinator"  # ring assembles (materializes) per round
         and len(trainers) > 1
     )
+    # Coordinator pinned to the canonically-first party unless the
+    # caller overrides it — and then kept for the WHOLE run.  The churn
+    # rationale: every delta-stream cache (contributions up, broadcast
+    # down, ring fallback) is keyed by its destination party, so a
+    # coordinator that rotates — e.g. "first active party" under client
+    # sampling — would re-point every stream each round, re-seeding
+    # full payloads everywhere and retaining stale multi-MB bases on
+    # every former coordinator.  Stability beats load-spreading here;
+    # spreading the load is what mode="ring" is for.
+    coord = coordinator if coordinator is not None else min(trainers)
     # ``wire_dtype`` (default bf16) is where error feedback earns its
     # keep: fp8 wire halves bf16's bytes again, and the carried
     # residual is what keeps it convergent.
@@ -241,6 +317,7 @@ def run_fedavg_rounds(
                 updates,
                 weights,
                 mode="coordinator",
+                coordinator=coord,
                 materialize=last,
             )
             if last and compress_wire:
@@ -251,28 +328,59 @@ def run_fedavg_rounds(
         # custom reducer (coordinator-side reduce + broadcast at N>2) —
         # one place decides who talks to whom.  The streaming path rides
         # the same coordinator topology but folds contributions in as
-        # their chunks arrive (bit-identical result).
-        if streaming_agg:
+        # their chunks arrive; the ring path replaces the hub with a
+        # reduce-scatter + all-gather.  All three are bit-identical.
+        #
+        # With error feedback (or a server optimizer) the aggregate
+        # must come back in f32: casting the mean to an aggressive
+        # wire dtype here would re-quantize it with no residual to
+        # compensate (the broadcast's delta cache still applies).
+        agg_out_dtype = (
+            "float32"
+            if (error_feedback or server_opt is not None)
+            else None
+        )
+        if mode == "ring":
+            from rayfed_tpu.fl.ring import (
+                RING_STATS,
+                RingRoundError,
+                ring_aggregate,
+            )
+
+            try:
+                avg = ring_aggregate(
+                    updates, weights, stream="fedavg",
+                    out_dtype=agg_out_dtype,
+                )
+            except RingRoundError as e:
+                # The abort reached every controller (poison cascade +
+                # commit ring), so all of them take this branch in
+                # lockstep: re-aggregate the SAME round's updates over
+                # the coordinator topology — owners still hold them, so
+                # no training work is lost.
+                from rayfed_tpu.fl.streaming import streaming_aggregate
+
+                logger.warning(
+                    "ring round %d aborted (%s); falling back to "
+                    "coordinator aggregation at %r", r, e, coord,
+                )
+                RING_STATS["fallback_rounds"] += 1
+                avg = streaming_aggregate(
+                    updates, weights, stream="fedavg",
+                    coordinator=coord, out_dtype=agg_out_dtype,
+                )
+        elif streaming_agg:
             from rayfed_tpu.fl.streaming import streaming_aggregate
 
-            # With error feedback (or a server optimizer) the aggregate
-            # must come back in f32: casting the mean to an aggressive
-            # wire dtype here would re-quantize it with no residual to
-            # compensate (the broadcast's delta cache still applies).
-            # Coordinator pinned to the canonically-first party (NOT the
-            # round's first active party): with client sampling the
-            # active set churns, and a rotating coordinator would churn
-            # every delta-stream destination — defeating the caches and
-            # retaining stale full-payload bases on every peer.
             avg = streaming_aggregate(
                 updates, weights, stream="fedavg",
-                coordinator=min(trainers),
-                out_dtype="float32"
-                if (error_feedback or server_opt is not None)
-                else None,
+                coordinator=coord,
+                out_dtype=agg_out_dtype,
             )
         else:
-            avg = aggregate(updates, weights, reducer=aggregator)
+            avg = aggregate(
+                updates, weights, reducer=aggregator, coordinator=coord
+            )
         if compress_wire:
             avg = decompress(avg)
         if server_opt is not None:

@@ -573,13 +573,21 @@ class TransportClient:
         if crc is not None:
             header["crc"] = crc
         policy = self._retry_policy
-        backoff = policy.initial_backoff_s
+        backoff: Optional[float] = None
         last_exc: Optional[Exception] = None
         for attempt in range(max(1, policy.max_attempts)):
             if attempt:
+                # Decorrelated jitter (policy.jitter, default on): N
+                # parties retrying the same dead peer must not wake in
+                # lockstep.  The chosen delay is logged so a retry storm
+                # is diagnosable from one party's logs.
+                backoff = policy.next_backoff(backoff)
+                logger.debug(
+                    "[%s] retrying send to %s in %.2fs (attempt %d/%d)",
+                    self._src_party, self._dest_party, backoff,
+                    attempt + 1, policy.max_attempts,
+                )
                 await asyncio.sleep(backoff)
-                backoff = min(backoff * policy.backoff_multiplier,
-                              policy.max_backoff_s)
             try:
                 ack = await self._roundtrip(
                     wire.MSG_DATA, header, payload_bufs, crc_trailer=crc_trailer
@@ -717,7 +725,7 @@ class TransportClient:
             # A delta frame only wins when at least one chunk is skipped.
             force_full = changed is None or len(changed) >= nch
             policy = self._retry_policy
-            backoff = policy.initial_backoff_s
+            backoff: Optional[float] = None
             last_exc: Optional[Exception] = None
             attempt = 0
             while attempt < max(1, policy.max_attempts):
@@ -767,11 +775,12 @@ class TransportClient:
                     )
                     if attempt >= max(1, policy.max_attempts):
                         break
-                    await asyncio.sleep(backoff)
-                    backoff = min(
-                        backoff * policy.backoff_multiplier,
-                        policy.max_backoff_s,
+                    backoff = policy.next_backoff(backoff)
+                    logger.debug(
+                        "[%s] retrying stream send to %s in %.2fs",
+                        self._src_party, self._dest_party, backoff,
                     )
+                    await asyncio.sleep(backoff)
                     continue
                 # ACKed: the peer now holds `full` — it IS the new base.
                 state.data = full

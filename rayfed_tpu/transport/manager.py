@@ -33,6 +33,23 @@ from rayfed_tpu.transport.server import TransportServer
 logger = logging.getLogger(__name__)
 
 
+def ring_neighbors(parties: Sequence[str], party: str) -> tuple:
+    """``(predecessor, successor)`` of ``party`` on the sorted ring.
+
+    The ring order is the SORTED party list — the same canonical order
+    every other cross-controller decision uses (sampling, stripe
+    ownership), so all parties derive identical neighbors without
+    coordination.  At N=2 the single peer is both neighbors; at N=1 the
+    party is its own.
+    """
+    ring = sorted(parties)
+    try:
+        i = ring.index(party)
+    except ValueError:
+        raise ValueError(f"{party!r} is not in the ring {ring}") from None
+    return ring[i - 1], ring[(i + 1) % len(ring)]
+
+
 class TransportManager:
     def __init__(
         self,
@@ -73,6 +90,18 @@ class TransportManager:
             "send_bytes": 0,
             "send_seconds": 0.0,
         }
+        # Per-destination send wall time (encode handoff → ACK), summed
+        # over sends: surfaces which peer a fan-out actually waits on.
+        # Read-modify-write from codec AND loop threads — guarded by a
+        # lock so overlapping completions to one destination can't lose
+        # an increment.
+        self._dest_lock = threading.Lock()
+        self._dest_seconds: Dict[str, float] = {}
+        self._dest_ops: Dict[str, int] = {}
+        # recv_stream bookkeeping: rendezvous key -> src party, so the
+        # health monitor can fail chunk-sink waits (which never park in
+        # the mailbox) when their source party dies.  Loop thread only.
+        self._stream_srcs: Dict[tuple, str] = {}
         # Set by api.init: () -> Optional[jax.sharding.Mesh].  Received
         # shard-encoded leaves whose sender sharding fits this mesh are
         # device_put with the equivalent local NamedSharding.
@@ -169,6 +198,11 @@ class TransportManager:
             parties = sorted(
                 self._mailbox.parties_with_waiters()
                 | self._mailbox.dead_parties()
+                # Chunk-sink waits (streaming/ring aggregation) never
+                # park in the mailbox — monitor their source parties
+                # too, or a peer dying mid reduce-scatter would leave
+                # the aggregator blind until the recv backstop.
+                | self._stream_sink_parties()
             )
             # Consecutive means consecutive: a party that left the
             # monitored set (its recvs resolved) starts from zero next
@@ -221,7 +255,40 @@ class TransportManager:
                             f"its pending sends will never arrive",
                         ).to_wire()
                         self._mailbox.fail_party(party, err)
+                        self._fail_party_sinks(party, err)
             rx_prev = rx_now
+
+    def _stream_sink_parties(self) -> set:
+        """Source parties of still-registered chunk sinks (loop thread).
+
+        Also purges bookkeeping for sinks that were consumed or
+        cancelled since the last cycle, so the map cannot grow beyond
+        the in-flight registrations.
+        """
+        live = {
+            key: src
+            for key, src in self._stream_srcs.items()
+            if self._server.peek_chunk_sink(key) is not None
+        }
+        self._stream_srcs = live
+        return set(live.values())
+
+    def _fail_party_sinks(self, party: str, err: Dict[str, str]) -> None:
+        """Deliver a dead party's failure to its pending chunk sinks —
+        the stream analogue of ``Mailbox.fail_party`` (loop thread)."""
+        for key, src in list(self._stream_srcs.items()):
+            if src != party:
+                continue
+            self._stream_srcs.pop(key, None)
+            sink = self._server.take_chunk_sink(key)
+            if sink is None:
+                continue
+            try:
+                sink.on_error(err)
+            except Exception:  # pragma: no cover - sink bug
+                logger.exception(
+                    "[%s] chunk sink failure delivery raised", self._party
+                )
 
     def stop(self) -> None:
         async def _shutdown():
@@ -449,8 +516,19 @@ class TransportManager:
                 _poison_all(e)
                 return
 
-            t0 = time.perf_counter()
-            for p in dests:
+            def _dispatch_one(p: str) -> None:
+                """One destination's write: client construction +
+                coroutine scheduling, off the shared encode thread.
+
+                These used to be issued sequentially after the shared
+                encode/CRC pass — a slow client construction (TLS
+                context, native warmup) or a long dispatch queue for
+                destination k delayed the FIRST byte to destinations
+                k+1..N.  Each destination now dispatches on its own
+                executor slot, and its wall time (dispatch → ACK) is
+                accounted per destination in ``get_stats()``.
+                """
+                t0 = time.perf_counter()
                 try:
                     client = self._get_client(p)
                     cf = asyncio.run_coroutine_threadsafe(
@@ -467,13 +545,18 @@ class TransportManager:
                         e,
                     )
                     out_refs[p].set_result(False)
-                    continue
+                    return
 
-                def _done(f, p=p):
+                def _done(f):
+                    dt = time.perf_counter() - t0
+                    with self._dest_lock:
+                        self._dest_seconds[p] = (
+                            self._dest_seconds.get(p, 0.0) + dt
+                        )
+                        self._dest_ops[p] = self._dest_ops.get(p, 0) + 1
                     try:
                         f.result()
                         self._peers_acked.add(p)
-                        dt = time.perf_counter() - t0
                         self.stats["send_bytes"] += nbytes
                         self.stats["send_seconds"] += dt
                         from rayfed_tpu import metrics
@@ -492,6 +575,12 @@ class TransportManager:
                         out_refs[p].set_result(False)
 
                 cf.add_done_callback(_done)
+
+            if len(dests) == 1:
+                _dispatch_one(dests[0])  # no second hop for the 1:1 path
+            else:
+                for p in dests:
+                    self._codec_pool.submit(_dispatch_one, p)
 
         if isinstance(data, LocalRef):
             def _on_data(ref: LocalRef) -> None:
@@ -581,25 +670,64 @@ class TransportManager:
         streaming aggregator builds on.  A push that raced in before
         registration is taken from the mailbox and delivered whole.  Do
         not also call :meth:`recv` on the same key.
+
+        ``src_party`` enrolls the key with the health monitor: if the
+        source dies mid-stream, the sink's ``on_error`` fires with the
+        peer-death error instead of waiting out the recv backstop (the
+        chunk-sink analogue of the mailbox's fail-fast).
         """
-        del src_party  # keyed by seq ids, like the mailbox
-        key = (str(upstream_seq_id), str(downstream_seq_id))
+        self.recv_stream_many(
+            [(src_party, upstream_seq_id, downstream_seq_id, sink)]
+        )
+
+    def recv_stream_many(self, entries: Sequence[tuple]) -> None:
+        """Register many ``(src_party, up, down, sink)`` chunk sinks in
+        ONE loop hop — the stripe demux of a ring round: a stripe
+        owner's N-1 contribution sinks attach in a single scheduling
+        round trip, so no early-arriving stripe pays an extra
+        cross-thread latency per source.  Semantics per entry are
+        exactly :meth:`recv_stream`."""
+        prepared = [
+            (str(src), (str(up), str(down)), sink)
+            for src, up, down, sink in entries
+        ]
 
         def _on_loop() -> None:
-            msg = self._mailbox.try_take(key)
-            if msg is not None:
-                try:
-                    if msg.error is not None:
-                        sink.on_error(msg.error)
-                    else:
-                        sink.on_complete(msg.payload)
-                except Exception:  # pragma: no cover - sink bug
-                    logger.exception(
-                        "[%s] stream sink failed on mailbox replay",
-                        self._party,
-                    )
-                return
-            self._server.register_chunk_sink(key, sink)
+            for src, key, sink in prepared:
+                msg = self._mailbox.try_take(key)
+                if msg is not None:
+                    try:
+                        if msg.error is not None:
+                            sink.on_error(msg.error)
+                        else:
+                            sink.on_complete(msg.payload)
+                    except Exception:  # pragma: no cover - sink bug
+                        logger.exception(
+                            "[%s] stream sink failed on mailbox replay",
+                            self._party,
+                        )
+                    continue
+                err = self._mailbox.party_failure(src)
+                if err is not None:
+                    # The source was ALREADY declared dead (e.g. a ring
+                    # fallback re-receiving from the peer that killed the
+                    # ring round): fail the sink now, exactly like
+                    # Mailbox.get fails a fresh recv on a dead party —
+                    # the monitor only fires on the alive→dead
+                    # transition, so a sink registered after it would
+                    # otherwise park until the recv backstop.  Raced-in
+                    # real data (above) is still preferred, like get's.
+                    self._mailbox.stats["peer_failed_recvs"] += 1
+                    try:
+                        sink.on_error(err)
+                    except Exception:  # pragma: no cover - sink bug
+                        logger.exception(
+                            "[%s] stream sink failed on dead-party "
+                            "fast-fail", self._party,
+                        )
+                    continue
+                self._server.register_chunk_sink(key, sink)
+                self._stream_srcs[key] = src
 
         self._loop.call_soon_threadsafe(_on_loop)
 
@@ -608,7 +736,24 @@ class TransportManager:
     ) -> None:
         """Detach a sink registered by :meth:`recv_stream` (timeout paths)."""
         key = (str(upstream_seq_id), str(downstream_seq_id))
-        self._loop.call_soon_threadsafe(self._server.unregister_chunk_sink, key)
+
+        def _on_loop() -> None:
+            self._server.unregister_chunk_sink(key)
+            self._stream_srcs.pop(key, None)
+
+        self._loop.call_soon_threadsafe(_on_loop)
+
+    def ring_neighbors(
+        self, parties: Optional[Sequence[str]] = None,
+        party: Optional[str] = None,
+    ) -> tuple:
+        """``(predecessor, successor)`` of ``party`` (default: this
+        party) on the sorted ring of ``parties`` (default: the whole
+        cluster) — see module-level :func:`ring_neighbors`."""
+        return ring_neighbors(
+            parties if parties is not None else list(self._cluster.parties),
+            party or self._party,
+        )
 
     # -- readiness ------------------------------------------------------------
 
@@ -652,6 +797,13 @@ class TransportManager:
             stats["send_prepare_s"] + stats["send_write_s"]
             - stats["send_frame_wall_s"],
         )
+        # Per-destination send wall (dispatch → ACK), cumulative: the
+        # fan-out / ring hop diagnostic — which peer does this party
+        # actually wait on.  Snapshots, not the live dicts (mutated
+        # from send callbacks).
+        with self._dest_lock:
+            stats["send_dest_seconds"] = dict(self._dest_seconds)
+            stats["send_dest_ops"] = dict(self._dest_ops)
         # Snapshot, not the live dict: get_stats runs on user threads
         # while the loop-thread health monitor mutates the dead set.
         stats["dead_parties"] = sorted(self._mailbox.dead_parties_snapshot())

@@ -234,6 +234,12 @@ class Mailbox:
     def dead_parties(self):
         return set(self._dead_parties)
 
+    def party_failure(self, party: str) -> Optional[Dict[str, str]]:
+        """The stored wire-form error of a declared-dead ``party``
+        (``None`` while it is considered alive).  Loop-thread only."""
+        err = self._dead_parties.get(party)
+        return dict(err) if err is not None else None
+
     def dead_parties_snapshot(self) -> frozenset:
         """Cross-thread-safe view of the dead set (see _dead_snapshot)."""
         return self._dead_snapshot

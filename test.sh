@@ -22,8 +22,11 @@ JAX_PLATFORMS=cpu python tool/check_wire_format.py
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 
 # Fast bench smoke: drives the streaming-aggregation + delta-cache
-# pipeline end-to-end over real sockets (small bundles, 4 parties) so a
-# transport/aggregation regression fails CI, not the next bench round.
+# pipeline AND the 4-party ring reduce-scatter round end-to-end over
+# real sockets (small bundles) so a transport/aggregation regression
+# fails CI, not the next bench round.  The ring section gates
+# coord_bytes_in_frac <= 0.4: the coordinator's share of cluster
+# ingress must stay at ~1/N (the hub pins it at ~0.5).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

@@ -18,6 +18,13 @@ The fingerprint is pinned in ``tool/wire_format.lock`` next to
   (re-pins the lock; commit it with the change)
 - layout unchanged, version bumped        → FAIL: gratuitous bump
 
+Payload-level contracts that ride INSIDE ordinary payloads (the ring
+stripe manifest) are fingerprinted too, with their own version knobs
+(e.g. ``ring.RING_STRIPE_VERSION``): changing one re-pins this lock via
+``--update`` WITHOUT a WIRE_FORMAT_VERSION bump, since the frame layout
+itself is unchanged.  The wire version only moves when the frame/
+manifest framing moves.
+
 Run by ``test.sh``; CI-safe (read-only without ``--update``).
 """
 
@@ -96,6 +103,19 @@ def compute_fingerprint() -> str:
         base_fp=wire.crc_fingerprint([1, 2, 3]),
     )
 
+    # Ring stripe manifest (the "rsm" sideband leaf of ring stripe
+    # payloads, rayfed_tpu.fl.ring): a cross-party contract layered on
+    # the ordinary payload manifest.  It changes no frame field, so its
+    # drift re-pins THIS lock without a WIRE_FORMAT_VERSION bump —
+    # ring.RING_STRIPE_VERSION is its own version knob and is
+    # fingerprinted alongside the schema.
+    from rayfed_tpu.fl import ring
+
+    stripe_manifest = ring.make_stripe_meta(
+        stripe=1, n_stripes=4, nblocks=9, total_elems=1 << 21,
+        dtype="bfloat16", phase="rs",
+    )
+
     material = json.dumps(
         {
             "manifest_schema": _schema(manifest),
@@ -108,6 +128,8 @@ def compute_fingerprint() -> str:
             "delta_manifest_schema": _schema(delta_manifest),
             "stream_header_keys": ["stm", "ccsz", "ccrc", "dlt"],
             "delta_chunk_bytes": wire.DELTA_CHUNK_BYTES,
+            "ring_stripe_schema": _schema(stripe_manifest),
+            "ring_stripe_version": ring.RING_STRIPE_VERSION,
         },
         sort_keys=True,
     )
