@@ -30,9 +30,11 @@ round of this framework itself (``BENCH_r*.json``), else 1.0.
 Usage: ``python bench.py`` (all configs; first run needs a few
 minutes for compiles).  ``python bench.py --fed-only`` skips the
 accelerator configs; ``--compute-only`` skips the federated ones;
-``--smoke`` runs only the streaming-aggregation and ring-aggregation
-round benches at reduced scale (the CI gate test.sh drives; the ring
-section additionally gates ``coord_bytes_in_frac <= 0.4``).
+``--smoke`` runs only the streaming-aggregation, ring-aggregation and
+pipelined-overlap round benches at reduced scale (the CI gate test.sh
+drives; the ring section additionally gates
+``coord_bytes_in_frac <= 0.4`` and the overlap section
+``overlap_hidden_comm_frac >= 0.5``).
 """
 
 from __future__ import annotations
@@ -809,6 +811,171 @@ def _fill_ring_extra(extra: dict, res: dict) -> None:
         f"(speedup {m['ring_vs_coord_speedup']:.2f}x — loopback "
         f"under-rewards the ring; the ingress fraction is the "
         f"topology invariant)"
+    )
+
+
+OVERLAPB_PARTIES = ("alice", "bob", "carol", "dave")
+OVERLAPB_CLUSTER = {
+    p: {"address": f"127.0.0.1:{13120 + i}"}
+    for i, p in enumerate(OVERLAPB_PARTIES)
+}
+
+
+def _run_overlap_party(party: str, result_q) -> None:
+    """Pipelined (overlap=True) vs synchronous FedAvg rounds, 4 parties.
+
+    Each party runs the SAME jitted matmul-chain trainer twice through
+    ``run_fedavg_rounds`` — once synchronous (streaming aggregation, the
+    pre-overlap round shape) and once pipelined — from the same warmed
+    state (compiles done, delta caches seeded).  Each party reports its
+    two walls plus the pipelined per-round timing breakdown; the parent
+    derives:
+
+    - ``overlap_hidden_comm_frac``: Σ hidden_s / Σ agg_s over the
+      pipelined rounds — the share of the comms wall (contribution
+      ready → aggregate landed) that ran UNDER the next round's local
+      compute instead of exposing the training thread.  The last round
+      has nothing to hide behind (though its window is also the
+      shortest — no concurrent compute stretching it); the CI gate is
+      ≥ 0.5.
+    - ``round_wall_speedup``: sync wall / overlap wall.  Ceiling is
+      (compute + comms) / max(compute, comms) ≤ 2; with compute sized
+      several × comms here the expected value is a modest 1.0–1.3 — the
+      hidden fraction is the structural invariant, the speedup is the
+      honest end-to-end number on THIS host's compute/comms ratio.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    smoke = bool(os.environ.get("RAYFED_BENCH_SMOKE"))
+    fed.init(address="local", cluster=OVERLAPB_CLUSTER, party=party)
+
+    # Model + local-step sizing: compute must be a healthy multiple of
+    # the loopback comms or there is nothing to hide the comms under.
+    # The bundle is kept SMALL (dim=512 → 0.5 MB bf16) so the comms
+    # wall is the 4-party round's fixed latency (pushes + fold +
+    # broadcast + ACK waits ≈ 100-300 ms on loopback) — genuinely idle
+    # time, hideable even on a saturated box.  Bigger bundles turn
+    # comms into CPU work (codec + fold) that CONTENDS with training
+    # instead of hiding under it.  steps=50 measures ≈ 170 ms of
+    # jitted compute per train single-process and ~0.8 s under 4-party
+    # contention on the 2-core bench host — comfortably above the
+    # comms window it has to cover.
+    dim = 512
+    steps = 50
+    rounds = 6 if smoke else 8
+
+    @fed.remote
+    class Trainer:
+        def __init__(self, seed: int):
+            self._a = jax.random.normal(
+                jax.random.PRNGKey(seed), (dim, dim)
+            ) / np.sqrt(dim)
+
+            @jax.jit
+            def _steps(a, w):
+                for _ in range(steps):
+                    w = 0.99 * w + 0.01 * jnp.tanh(a @ w)
+                return w
+
+            self._steps = _steps
+
+        def train(self, params):
+            from rayfed_tpu.fl import compression as C
+
+            w = C.decompress(params, jnp.float32)["w"]
+            w = self._steps(self._a, w)
+            out = C.compress({"w": w}, packed=True)
+            # Materialize INSIDE the train body: jax dispatches async, so
+            # without this the jitted chain would return in ~1 ms and the
+            # actual compute would lazily execute inside the comms lane's
+            # payload encode — "comms" would absorb the round's compute
+            # and there would be nothing left on the training side to
+            # hide it under (real trainers synchronize every round on
+            # data loading / metrics anyway).
+            jax.block_until_ready(out.buf)
+            return out
+
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(99), (dim, dim))
+    }
+    trainers = {
+        p: Trainer.party(p).remote(i)
+        for i, p in enumerate(OVERLAPB_PARTIES)
+    }
+
+    def run(overlap: bool, nrounds: int, timings=None):
+        kw = (
+            {"overlap": True}
+            if overlap
+            else {"streaming_agg": True}
+        )
+        t0 = time.perf_counter()
+        out = run_fedavg_rounds(
+            trainers, params, rounds=nrounds, compress_wire=True,
+            packed_wire=True, timings=timings, **kw,
+        )
+        jax.block_until_ready(out["w"])
+        return time.perf_counter() - t0
+
+    run(False, 1)  # warmup: train/fold compiles + delta-cache seed
+    run(True, 2)  # warmup: DGA-correction compile + lane spin-up
+    sync_t: list = []
+    sync_s = run(False, rounds, timings=sync_t)
+    ov_t: list = []
+    overlap_s = run(True, rounds, timings=ov_t)
+
+    report = {
+        "rounds": rounds,
+        "sync_s": sync_s,
+        "overlap_s": overlap_s,
+        "hidden_s": sum(r["hidden_s"] for r in ov_t),
+        "agg_s": sum(r["agg_s"] for r in ov_t),
+        "local_s": sum(r["local_s"] for r in ov_t),
+        "sync_agg_s": sum(r["agg_s"] for r in sync_t),
+    }
+    if result_q is not None:
+        result_q.put((party, report))
+    fed.shutdown()
+
+
+def _overlap_bench_metrics(res: dict) -> dict:
+    n = len(res)
+    rounds = next(iter(res.values()))["rounds"]
+    sync_wall = sum(v["sync_s"] for v in res.values()) / n
+    ov_wall = sum(v["overlap_s"] for v in res.values()) / n
+    hidden = sum(v["hidden_s"] for v in res.values())
+    agg = sum(v["agg_s"] for v in res.values())
+    return {
+        "overlap_hidden_comm_frac": round(hidden / max(agg, 1e-9), 3),
+        "round_wall_speedup": round(sync_wall / ov_wall, 3),
+        "overlap_round_ms": round(ov_wall / rounds * 1e3, 1),
+        "sync_round_ms": round(sync_wall / rounds * 1e3, 1),
+        "overlap_comms_ms_per_round": round(
+            agg / n / rounds * 1e3, 1
+        ),
+        "overlap_local_ms_per_round": round(
+            sum(v["local_s"] for v in res.values()) / n / rounds * 1e3, 1
+        ),
+    }
+
+
+def _fill_overlap_extra(extra: dict, res: dict) -> None:
+    m = _overlap_bench_metrics(res)
+    extra.update(m)
+    _log(
+        f"  overlap: {m['overlap_hidden_comm_frac']:.0%} of the comms "
+        f"wall hidden under local compute "
+        f"(comms {m['overlap_comms_ms_per_round']:.0f} ms under local "
+        f"{m['overlap_local_ms_per_round']:.0f} ms per round); round "
+        f"{m['overlap_round_ms']:.0f} ms vs sync "
+        f"{m['sync_round_ms']:.0f} ms "
+        f"(speedup {m['round_wall_speedup']:.2f}x; ceiling is "
+        f"compute-bound — the hidden fraction is the invariant)"
     )
 
 
@@ -2186,6 +2353,13 @@ def main() -> None:
                 timeout=420,
             )
             _fill_ring_extra(extra, rres)
+        with _section(extra, "overlap"):
+            _log("pipelined-rounds smoke (4-party overlap vs sync)...")
+            ores = _multi_party(
+                "_run_overlap_party", parties=OVERLAPB_PARTIES, ndev=1,
+                timeout=420,
+            )
+            _fill_overlap_extra(extra, ores)
         record = {
             "metric": "cross_party_stream_agg_GBps",
             "value": extra.get("cross_party_stream_agg_GBps", 0.0),
@@ -2195,7 +2369,11 @@ def main() -> None:
         }
         record.update(extra)
         print(json.dumps(record), flush=True)
-        if "stream_agg_error" in extra or "ring_agg_error" in extra:
+        if (
+            "stream_agg_error" in extra
+            or "ring_agg_error" in extra
+            or "overlap_error" in extra
+        ):
             raise SystemExit(1)
         # CI gate (test.sh): the ring must actually de-bottleneck the
         # coordinator — its share of cluster ingress bytes at or near
@@ -2205,6 +2383,16 @@ def main() -> None:
             _log(
                 f"ring smoke gate FAILED: coord_bytes_in_frac={frac} "
                 f"(must be <= 0.4)"
+            )
+            raise SystemExit(1)
+        # CI gate (test.sh): the pipelined engine must actually hide
+        # comms under compute — at least half of the per-round comms
+        # wall (the structural ceiling is (R-1)/R = 0.75 at R=4).
+        hfrac = extra.get("overlap_hidden_comm_frac")
+        if hfrac is None or hfrac < 0.5:
+            _log(
+                f"overlap smoke gate FAILED: "
+                f"overlap_hidden_comm_frac={hfrac} (must be >= 0.5)"
             )
             raise SystemExit(1)
         return
@@ -2361,6 +2549,15 @@ def main() -> None:
                 timeout=900,
             )
             _fill_ring_extra(extra, rres)
+            _settle()
+
+        with _section(extra, "overlap"):
+            _log("pipelined FedAvg rounds (4-party overlap vs sync)...")
+            ores = _multi_party(
+                "_run_overlap_party", parties=OVERLAPB_PARTIES, ndev=1,
+                timeout=900,
+            )
+            _fill_overlap_extra(extra, ores)
             _settle()
 
         with _section(extra, "lora_2party"):

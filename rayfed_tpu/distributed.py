@@ -359,7 +359,7 @@ class MultiHostTransport:
     # -- proxy interface ------------------------------------------------------
 
     def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
-             stream=None):
+             stream=None, round_tag=None):
         if self._inner is not None:
             return self._inner.send(
                 dest_party=dest_party,
@@ -367,12 +367,13 @@ class MultiHostTransport:
                 upstream_seq_id=upstream_seq_id,
                 downstream_seq_id=downstream_seq_id,
                 stream=stream,
+                round_tag=round_tag,
             )
         # Non-leader: the leader's identical program does the real push.
         return LocalRef.from_value(True)
 
     def send_many(self, dest_parties, data, upstream_seq_id,
-                  downstream_seq_id, stream=None):
+                  downstream_seq_id, stream=None, round_tag=None):
         """Fan-out broadcast (one shared encode) — leader only; see
         :meth:`TransportManager.send_many`."""
         if self._inner is not None:
@@ -382,6 +383,7 @@ class MultiHostTransport:
                 upstream_seq_id=upstream_seq_id,
                 downstream_seq_id=downstream_seq_id,
                 stream=stream,
+                round_tag=round_tag,
             )
         return {p: LocalRef.from_value(True) for p in dest_parties}
 

@@ -237,6 +237,58 @@ class TaskExecutor:
         self._pool.shutdown(wait=wait)
 
 
+class CommsLane(TaskExecutor):
+    """A dedicated single-thread lane for cross-party comms orchestration.
+
+    The pipelined round engine (:mod:`rayfed_tpu.fl.overlap`) hands each
+    round's push + aggregation to this lane and immediately returns to
+    local compute.  The lane is deliberately NOT the task executor and
+    NOT the transport codec pool:
+
+    - Task-pool threads run training bodies; a blocking multi-second
+      ``streaming_aggregate`` wait parked there would steal a worker
+      from (and at pool saturation, deadlock behind) the very training
+      work the overlap is supposed to hide it under.
+    - Codec-pool threads encode/decode payload bytes; the aggregation
+      wait must be free to *consume* codec work, so waiting on the codec
+      pool could self-deadlock.
+
+    One thread, not a pool: round *k+1*'s aggregate depends on round
+    *k*'s anyway (the DGA correction consumes it), so comms jobs are
+    inherently serial — a single lane makes that ordering structural
+    instead of relying on callers to chain futures.
+
+    ``bind_runtime_fn`` is invoked on the lane thread before each job so
+    ``fed.*``/``get_runtime()`` calls made inside resolve to the owning
+    party's runtime (the same contract as :class:`TaskExecutor`).
+
+    Implementation-wise this IS a one-worker :class:`TaskExecutor` — the
+    isolation argument above is about not sharing the *instances*, not
+    about needing different machinery — so it subclasses rather than
+    duplicating the pool/bind/shutdown plumbing.
+    """
+
+    def __init__(
+        self,
+        name: str = "rayfed-comms",
+        bind_runtime_fn: Optional[Callable[[], None]] = None,
+    ) -> None:
+        super().__init__(
+            max_workers=1, thread_name_prefix=name,
+            bind_runtime_fn=bind_runtime_fn,
+        )
+
+    def submit(self, fn: Callable, *args, **kwargs) -> LocalRef:
+        """Queue ``fn(*args, **kwargs)`` on the lane; returns a LocalRef.
+
+        (Simpler signature than :meth:`TaskExecutor.submit` — lane jobs
+        pass their arguments pre-resolved and need no name stamping.)
+        """
+        if self._shutdown:
+            raise RuntimeError("CommsLane has been shut down")
+        return self.submit_resolved(fn, *args, **kwargs)
+
+
 def _split_future(
     future: concurrent.futures.Future, num_returns: int
 ) -> list[LocalRef]:

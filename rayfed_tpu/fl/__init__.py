@@ -33,6 +33,7 @@ from rayfed_tpu.fl.fedavg import (
     tree_average,
     tree_weighted_sum,
 )
+from rayfed_tpu.fl.overlap import PipelinedRoundRunner, dga_correct
 from rayfed_tpu.fl.ring import RingRoundError, ring_aggregate
 from rayfed_tpu.fl.streaming import (
     StreamingAggregator,
@@ -61,6 +62,8 @@ __all__ = [
     "streaming_aggregate",
     "ring_aggregate",
     "RingRoundError",
+    "PipelinedRoundRunner",
+    "dga_correct",
     "StreamingAggregator",
     "StripeAggregator",
     "ErrorFeedback",

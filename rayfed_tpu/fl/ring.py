@@ -98,6 +98,12 @@ def _maybe_fault(phase: str) -> None:
         _fault_hook(phase)
 
 
+# Seq ids one ring_aggregate call consumes — callers pre-allocating ids
+# for an off-main-thread call (fl.overlap's comms lane) draw exactly
+# this many from runtime.next_seq_id() in program order.
+RING_SEQ_IDS = 5
+
+
 class RingRoundError(RuntimeError):
     """A ring round aborted (peer death, wire failure, poisoned hop).
 
@@ -181,6 +187,9 @@ def ring_aggregate(
     timeout: Optional[float] = None,
     out_dtype: Any = None,
     chunk_elems: Optional[int] = None,
+    seq_ids: Optional[Sequence[int]] = None,
+    round_tag: Optional[int] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Any:
     """FedAvg round over the chunk-striped ring (see module docstring).
 
@@ -198,6 +207,16 @@ def ring_aggregate(
     value (tests use it to stripe small payloads).  Aborted rounds
     raise :class:`RingRoundError` on **every** controller (poison
     cascade + commit ring) so callers can fall back in lockstep.
+
+    ``seq_ids``: :data:`RING_SEQ_IDS` pre-allocated rendezvous ids (in
+    ``next_seq_id`` order).  Default (None) allocates them here; a call
+    dispatched to a background lane (:mod:`rayfed_tpu.fl.overlap`) MUST
+    pass main-thread-drawn ids — see
+    :func:`~rayfed_tpu.fl.streaming.streaming_aggregate`.  ``round_tag``
+    stamps every frame of the round with the round index
+    (``wire.ROUND_TAG_KEY``).  ``timings`` (optional dict) receives
+    ``push_s`` (reduce-scatter pushes ACKed) and ``agg_s`` (whole-call
+    wall).
     """
     from rayfed_tpu.fed_object import FedObject
     from rayfed_tpu.fl.fedavg import (
@@ -245,11 +264,17 @@ def ring_aggregate(
     # Seq ids — allocated unconditionally and identically on every
     # controller (success, abort and non-member paths all consume the
     # same five), preserving the rendezvous determinism contract.
-    rs_id = runtime.next_seq_id()
-    ag_id = runtime.next_seq_id()
-    commit_id = runtime.next_seq_id()
-    release_id = runtime.next_seq_id()
-    nm_id = runtime.next_seq_id()
+    if seq_ids is None:
+        rs_id = runtime.next_seq_id()
+        ag_id = runtime.next_seq_id()
+        commit_id = runtime.next_seq_id()
+        release_id = runtime.next_seq_id()
+        nm_id = runtime.next_seq_id()
+    else:
+        rs_id, ag_id, commit_id, release_id, nm_id = seq_ids
+    import time as _time
+
+    t_call0 = _time.perf_counter()
 
     me = runtime.party
     backstop = (
@@ -302,7 +327,7 @@ def ring_aggregate(
         broadcast aborts the round instead of leaving them parked."""
         refs = send_many_on_runtime(
             runtime, non_members, result, nm_id, nm_id,
-            stream=f"{stream}/nm",
+            stream=f"{stream}/nm", round_tag=round_tag,
         )
         for p, ref in refs.items():
             if not ref.resolve(timeout=backstop):
@@ -317,7 +342,7 @@ def ring_aggregate(
         the members' round already committed."""
         refs = send_many_on_runtime(
             runtime, non_members, {"ok": 1}, f"{release_id}.nm",
-            release_id,
+            release_id, round_tag=round_tag,
         )
         for p, ref in refs.items():
             if not ref.resolve(timeout=backstop):  # pragma: no cover
@@ -442,7 +467,7 @@ def ring_aggregate(
                     send_on_runtime(
                         runtime, ring[k], payload,
                         f"{rs_id}.rs.{my_idx}.{k}", rs_id,
-                        stream=f"{stream}/rs",
+                        stream=f"{stream}/rs", round_tag=round_tag,
                     ),
                 )
             )
@@ -456,6 +481,8 @@ def ring_aggregate(
                 raise RingRoundError(
                     f"reduce-scatter push {up!r} to {dest!r} failed"
                 )
+        if timings is not None:
+            timings["push_s"] = _time.perf_counter() - t_call0
 
         _maybe_fault("reduce")
         if my_stripe_elems:
@@ -505,7 +532,7 @@ def ring_aggregate(
         def _ag_send(k: int, hop: int, payload: Dict[str, Any]) -> None:
             ref = send_on_runtime(
                 runtime, succ, payload, f"{ag_id}.ag.{k}.{hop}", ag_id,
-                stream=f"{stream}/ag/{k}",
+                stream=f"{stream}/ag/{k}", round_tag=round_tag,
             )
             with fwd_lock:
                 fwd_refs.append((k, hop, ref))
@@ -591,7 +618,7 @@ def ring_aggregate(
 
         def _token_send(up: str, down) -> None:
             if not send_on_runtime(
-                runtime, succ, token, up, down
+                runtime, succ, token, up, down, round_tag=round_tag
             ).resolve(timeout=backstop):
                 raise RingRoundError(
                     f"commit token {up!r} to {succ!r} failed"
@@ -637,6 +664,9 @@ def ring_aggregate(
         except Exception:  # pragma: no cover - post-commit best effort
             logger.exception("[%s] non-member release pass failed", me)
     RING_STATS["rounds_completed"] += 1
+    if timings is not None:
+        timings.setdefault("push_s", 0.0)
+        timings["agg_s"] = _time.perf_counter() - t_call0
     return result
 
 

@@ -764,6 +764,12 @@ class StripeAggregator(StreamingAggregator):
         return np.asarray(out_buf)
 
 
+# Seq ids one streaming_aggregate call consumes — callers pre-allocating
+# ids for an off-main-thread call (fl.overlap's comms lane) draw exactly
+# this many from runtime.next_seq_id() in program order.
+STREAM_AGG_SEQ_IDS = 2
+
+
 def streaming_aggregate(
     fed_objects: Sequence[Any],
     weights: Optional[Sequence[float]] = None,
@@ -772,6 +778,9 @@ def streaming_aggregate(
     stream: str = "sagg",
     timeout: Optional[float] = None,
     out_dtype: Any = None,
+    seq_ids: Optional[Sequence[int]] = None,
+    round_tag: Optional[int] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Any:
     """FedAvg round over the streaming + delta-cache pipeline.
 
@@ -786,6 +795,23 @@ def streaming_aggregate(
 
     ``stream`` names the delta-cache scope — keep it constant across
     rounds of the same training loop so the caches hit.
+
+    ``seq_ids``: :data:`STREAM_AGG_SEQ_IDS` pre-allocated rendezvous ids
+    ``(contrib_id, result_id)``.  Default (None) allocates them here —
+    correct whenever the call runs on the thread driving the fed
+    program.  A call dispatched to a background lane (the pipelined
+    round engine, :mod:`rayfed_tpu.fl.overlap`) MUST pass ids drawn on
+    the main thread instead: an off-thread ``next_seq_id`` would
+    interleave nondeterministically with the main thread's task ids and
+    desync the controllers' rendezvous streams.
+
+    ``round_tag`` stamps every frame of the round (contributions and
+    broadcast) with the round index (``wire.ROUND_TAG_KEY``).
+
+    ``timings`` (optional dict) receives ``push_s`` (this party's
+    contribution pushes ACKed, 0.0 on the coordinator — its own
+    contribution never crosses the wire) and ``agg_s`` (wall time of the
+    whole call).
 
     Multi-host parties: only the party LEADER process runs the
     cross-party wire, so streaming aggregation works on the leader and
@@ -812,8 +838,12 @@ def streaming_aggregate(
             )
     # Allocated identically on every controller — the determinism
     # contract that keys the rendezvous.
-    contrib_id = runtime.next_seq_id()
-    result_id = runtime.next_seq_id()
+    if seq_ids is None:
+        contrib_id = runtime.next_seq_id()
+        result_id = runtime.next_seq_id()
+    else:
+        contrib_id, result_id = seq_ids
+    t_call0 = time.perf_counter()
     me = runtime.party
     coord = coordinator or objs[0].get_party()
     backstop = timeout if timeout is not None else runtime.job_config.recv_backstop_s
@@ -823,16 +853,31 @@ def streaming_aggregate(
         own_seq = 0  # per-OWNER ordinal: stable under client sampling,
         # unlike the global position (which churns with the active set
         # and would rotate delta-stream names every round).
+        push_done: List[float] = []
         for obj in objs:
             if obj.get_party() == me:
-                send_on_runtime(
+                push_ref = send_on_runtime(
                     runtime, coord, obj.get_local_ref(),
                     obj.get_fed_task_id(), contrib_id,
                     stream=f"{stream}/up/{me}/{own_seq}",
+                    round_tag=round_tag,
                 )
+                if timings is not None:
+                    push_ref.add_done_callback(
+                        lambda _r: push_done.append(time.perf_counter())
+                    )
                 own_seq += 1
         ref = recv_on_runtime(runtime, coord, result_id, result_id)
-        return ref.resolve(timeout=backstop)
+        result = ref.resolve(timeout=backstop)
+        if timings is not None:
+            # The result broadcast only lands after the coordinator
+            # folded every contribution, so the ACK timestamps are
+            # complete by now.
+            timings["push_s"] = (
+                max(push_done) - t_call0 if push_done else 0.0
+            )
+            timings["agg_s"] = time.perf_counter() - t_call0
+        return result
 
     agg = StreamingAggregator(
         len(objs),
@@ -888,6 +933,9 @@ def streaming_aggregate(
     if others:
         send_many_on_runtime(
             runtime, others, result, result_id, result_id,
-            stream=f"{stream}/down",
+            stream=f"{stream}/down", round_tag=round_tag,
         )
+    if timings is not None:
+        timings["push_s"] = 0.0  # own contribution never hits the wire
+        timings["agg_s"] = time.perf_counter() - t_call0
     return result

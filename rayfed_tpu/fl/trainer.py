@@ -66,6 +66,9 @@ def run_fedavg_rounds(
     wire_dtype: Any = None,
     mode: str = "coordinator",
     coordinator: Optional[str] = None,
+    overlap: bool = False,
+    timings: Optional[list] = None,
+    ring_chunk_elems: Optional[int] = None,
 ) -> Any:
     """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
 
@@ -147,6 +150,30 @@ def run_fedavg_rounds(
       bandwidth-poor; keep it STABLE across a training run, because
       every delta-stream cache is keyed by destination and a moving
       coordinator re-seeds full payloads on every peer it moves to.
+
+    - ``overlap``: double-buffer the rounds
+      (:class:`rayfed_tpu.fl.overlap.PipelinedRoundRunner`): round *k*'s
+      push + aggregation runs on a dedicated comms lane WHILE round
+      *k+1* trains from each party's locally-updated model, and the
+      late aggregate is folded in with the DGA correction
+      ``w ← agg_k + (w_local − w_local_at_send)`` — per-round wall drops
+      to ``max(compute, comms)`` at the cost of one round of bounded
+      staleness (``overlap=False`` keeps today's exact synchronous
+      semantics).  Requires ``compress_wire`` + ``packed_wire``;
+      composes with ``mode="coordinator"`` (streaming aggregation) and
+      ``mode="ring"`` (with the same-round coordinator fallback on ring
+      aborts); mutually exclusive with ``server_opt``, ``aggregator``,
+      ``sample``, ``error_feedback`` and checkpointing (each needs the
+      exact synchronous round boundary).
+    - ``timings``: optional list receiving one ``{"local_s", "push_s",
+      "agg_s", "hidden_s"}`` dict per round (seconds; also logged at
+      debug level).  ``hidden_s`` is the share of the round's comms wall
+      that ran under local compute — 0 on the synchronous path by
+      construction.  Requesting timings materializes every round (the
+      lazy pipelined path has no per-round boundary to time).
+    - ``ring_chunk_elems``: override the ring topology's stripe-grid
+      granularity (``mode="ring"`` only; every controller must pass the
+      same value — tests use it to stripe small models).
 
     Without a server optimizer the rounds **pipeline**: the averaged
     model flows into the next round as a lazy ``FedObject`` (no
@@ -233,6 +260,34 @@ def run_fedavg_rounds(
             f"coordinator {coordinator!r} is not a training party "
             f"({sorted(trainers)})"
         )
+    if ring_chunk_elems is not None and mode != "ring":
+        raise ValueError(
+            "ring_chunk_elems only applies to mode='ring' (it sets the "
+            "ring stripe grid granularity)"
+        )
+    if overlap:
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "overlap=True requires compress_wire=True and "
+                "packed_wire=True (the overlapped aggregation unit is "
+                "the packed wire buffer, and the DGA correction runs on "
+                "it)"
+            )
+        incompat = {
+            "server_opt": server_opt is not None,
+            "aggregator": aggregator is not None,
+            "sample": sample is not None and sample != len(trainers),
+            "error_feedback": error_feedback,
+            "checkpointer": checkpointer is not None,
+        }
+        bad = [k for k, v in incompat.items() if v]
+        if bad:
+            raise ValueError(
+                f"overlap=True is incompatible with {bad}: each needs "
+                "the exact synchronous round boundary (the overlapped "
+                "aggregate lands one round late, under the next round's "
+                "compute)"
+            )
 
     from rayfed_tpu.fed_object import FedObject
 
@@ -261,6 +316,7 @@ def run_fedavg_rounds(
         and not streaming_agg  # streaming materializes at the reducer
         and not error_feedback  # the residual needs the driver's tree
         and mode == "coordinator"  # ring assembles (materializes) per round
+        and timings is None  # per-round timing needs a round boundary
         and len(trainers) > 1
     )
     # Coordinator pinned to the canonically-first party unless the
@@ -279,6 +335,23 @@ def run_fedavg_rounds(
     import jax.numpy as _jnp
 
     wire_dt = _jnp.bfloat16 if wire_dtype is None else wire_dtype
+
+    if overlap:
+        # The pipelined engine owns its own loop shape (double-buffered
+        # rounds + DGA correction + comms lane) — see fl/overlap.py.
+        from rayfed_tpu.fl.overlap import PipelinedRoundRunner
+
+        runner = PipelinedRoundRunner(
+            trainers,
+            weights=weights,
+            mode=mode,
+            coordinator=coord,
+            wire_dtype=wire_dt,
+            on_round=on_round,
+            ring_chunk_elems=ring_chunk_elems,
+        )
+        return runner.run(params, rounds, timings=timings)
+
     ef = ErrorFeedback(wire_dt) if error_feedback else None
 
     parties = list(trainers)
@@ -293,6 +366,14 @@ def run_fedavg_rounds(
         return sample_parties(parties, int(sample), sample_seed, r)
 
     current: Any = params  # tree, or FedObject in pipelined rounds
+
+    me = None
+    if timings is not None:
+        import time as _time
+
+        from rayfed_tpu.runtime import get_runtime
+
+        me = get_runtime().party
 
     for r in range(start_round, rounds):
         active = round_parties(r)
@@ -310,7 +391,26 @@ def run_fedavg_rounds(
             )
         else:
             outgoing = current
+        rec = None
+        if timings is not None:
+            # Per-round breakdown (satellite of the overlap work): the
+            # synchronous path exposes local/push/agg walls with
+            # hidden_s pinned at 0 — comms fully serialize behind
+            # compute here, which is exactly what overlap=True removes.
+            rec = {
+                "local_s": 0.0, "push_s": 0.0, "agg_s": 0.0,
+                "hidden_s": 0.0,
+            }
+            t_r0 = _time.perf_counter()
         updates = [trainers[p].train.remote(outgoing) for p in active]
+        if rec is not None and me in active:
+            my_ref = updates[active.index(me)].get_local_ref()
+            if my_ref is not None:
+                my_ref.add_done_callback(
+                    lambda _ref, rec=rec, t0=t_r0: rec.__setitem__(
+                        "local_s", _time.perf_counter() - t0
+                    )
+                )
         if pipeline:
             last = r == rounds - 1
             current = aggregate(
@@ -351,6 +451,7 @@ def run_fedavg_rounds(
                 avg = ring_aggregate(
                     updates, weights, stream="fedavg",
                     out_dtype=agg_out_dtype,
+                    chunk_elems=ring_chunk_elems, timings=rec,
                 )
             except RingRoundError as e:
                 # The abort reached every controller (poison cascade +
@@ -368,6 +469,7 @@ def run_fedavg_rounds(
                 avg = streaming_aggregate(
                     updates, weights, stream="fedavg",
                     coordinator=coord, out_dtype=agg_out_dtype,
+                    timings=rec,
                 )
         elif streaming_agg:
             from rayfed_tpu.fl.streaming import streaming_aggregate
@@ -376,11 +478,15 @@ def run_fedavg_rounds(
                 updates, weights, stream="fedavg",
                 coordinator=coord,
                 out_dtype=agg_out_dtype,
+                timings=rec,
             )
         else:
+            t_a0 = _time.perf_counter() if rec is not None else 0.0
             avg = aggregate(
                 updates, weights, reducer=aggregator, coordinator=coord
             )
+            if rec is not None:
+                rec["agg_s"] = _time.perf_counter() - t_a0
         if compress_wire:
             avg = decompress(avg)
         if server_opt is not None:
@@ -394,5 +500,18 @@ def run_fedavg_rounds(
             if state is not None:
                 snap["server_state"] = state
             checkpointer.save(r + 1, snap)
+        if rec is not None:
+            # The aggregation call blocks on this party's own training
+            # output before any byte can move, so its measured walls
+            # include the local wait — subtract it to report the comms-
+            # only window (what overlap=True would hide).
+            rec["push_s"] = max(0.0, rec["push_s"] - rec["local_s"])
+            rec["agg_s"] = max(0.0, rec["agg_s"] - rec["local_s"])
+            timings.append(rec)
+            logger.debug(
+                "round %d timings: local=%.3fs push=%.3fs agg=%.3fs "
+                "hidden=%.3fs", r, rec["local_s"], rec["push_s"],
+                rec["agg_s"], rec["hidden_s"],
+            )
 
     return current

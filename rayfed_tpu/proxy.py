@@ -21,9 +21,13 @@ def send_on_runtime(
     upstream_seq_id: Any,
     downstream_seq_id: Any,
     stream: Any = None,
+    round_tag: Any = None,
 ) -> LocalRef:
     """``stream``: stable stream name enabling the transport's per-peer
-    delta cache (ship only changed chunks — see TransportClient)."""
+    delta cache (ship only changed chunks — see TransportClient).
+    ``round_tag``: federated round index stamped into the frame metadata
+    (``wire.ROUND_TAG_KEY``) so in-flight pipelined rounds stay
+    attributable — see :meth:`TransportManager.send`."""
     if runtime.send_proxy is None:
         raise RuntimeError("transport not started; call fed.init() first")
     result_ref = runtime.send_proxy.send(
@@ -32,6 +36,7 @@ def send_on_runtime(
         upstream_seq_id=upstream_seq_id,
         downstream_seq_id=downstream_seq_id,
         stream=stream,
+        round_tag=round_tag,
     )
     if runtime.cleanup_manager is not None:
         runtime.cleanup_manager.push_to_sending(result_ref)
@@ -45,6 +50,7 @@ def send_many_on_runtime(
     upstream_seq_id: Any,
     downstream_seq_id: Any,
     stream: Any = None,
+    round_tag: Any = None,
 ) -> dict:
     """Broadcast fan-out: ONE payload encode shared by every destination.
 
@@ -62,6 +68,7 @@ def send_many_on_runtime(
         upstream_seq_id=upstream_seq_id,
         downstream_seq_id=downstream_seq_id,
         stream=stream,
+        round_tag=round_tag,
     )
     if runtime.cleanup_manager is not None:
         for ref in refs.values():

@@ -118,6 +118,7 @@ class TransportClient:
         server_hostname: Optional[str] = None,
         checksum: Optional[bool] = None,
         pool_size: int = 2,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
     ) -> None:
         if checksum is None:
             # Match the manager's policy: checksum only when the fast C++
@@ -139,6 +140,10 @@ class TransportClient:
         self._metadata = dict(metadata or {})
         self._ssl_context = ssl_context
         self._server_hostname = server_hostname
+        # Event loop the coroutines run on, when known (the manager
+        # passes its loop thread's).  Only send_data_async needs it —
+        # the coroutine API is loop-agnostic as ever.
+        self._loop = loop
         self._rid = itertools.count(1)
         self._conns: List[_Conn] = []
         self._conn_lock = asyncio.Lock()
@@ -615,6 +620,53 @@ class TransportClient:
             f"send to {self._dest_party} failed after "
             f"{policy.max_attempts} attempts: {last_exc}"
         )
+
+    def send_data_async(
+        self,
+        payload_bufs: List,
+        upstream_seq_id: str,
+        downstream_seq_id: str,
+        **kwargs,
+    ):
+        """Thread-safe, non-blocking :meth:`send_data`: returns a
+        completion future instead of awaiting the ACK.
+
+        The returned :class:`~rayfed_tpu.executor.LocalRef` resolves to
+        the ACK result string once the peer acknowledged the FINAL frame
+        of the send (for delta streams that includes any transparent
+        full-payload re-seed after a ``delta_base`` desync), and errs
+        with the send's failure — peer death after retries, a re-seed
+        that itself failed, an oversize payload.  Callable from any
+        thread; the client must have been constructed with its event
+        loop bound (``loop=``; :class:`TransportManager` always does).
+        Accepts every :meth:`send_data` keyword (``metadata``, ``crc``,
+        ``stream``, ``stream_snapshot``, ``error``).
+        """
+        from rayfed_tpu.executor import LocalRef
+
+        if self._loop is None:
+            raise RuntimeError(
+                "send_data_async needs the client's event loop bound at "
+                "construction (loop=...); direct awaiters use send_data"
+            )
+        cf = asyncio.run_coroutine_threadsafe(
+            self.send_data(
+                payload_bufs, upstream_seq_id, downstream_seq_id, **kwargs
+            ),
+            self._loop,
+        )
+        out = LocalRef()
+
+        def _done(f):
+            if f.cancelled():
+                out.set_exception(SendError("client send cancelled"))
+            elif f.exception() is not None:
+                out.set_exception(f.exception())
+            else:
+                out.set_result(f.result())
+
+        cf.add_done_callback(_done)
+        return out
 
     @staticmethod
     def snapshot_stream_payload(payload_bufs: List):

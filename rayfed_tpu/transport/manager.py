@@ -360,6 +360,7 @@ class TransportManager:
                     ssl_context=tls_utils.client_ssl_context(self._cluster.tls_config),
                     checksum=bool(opts.get("checksum", True)),
                     pool_size=int(opts.get("connections_per_peer", 2)),
+                    loop=self._loop,
                 )
                 self._clients[dest_party] = client
             return client
@@ -427,6 +428,7 @@ class TransportManager:
         upstream_seq_id: Any,
         downstream_seq_id: Any,
         stream: Optional[str] = None,
+        round_tag: Optional[int] = None,
     ) -> LocalRef:
         """Owner-initiated push.  Returns a LocalRef resolving to True/False.
 
@@ -439,10 +441,16 @@ class TransportManager:
         ``stream``: a stable stream name routes the push through the
         per-peer delta cache (only changed chunks cross the wire — see
         :meth:`TransportClient._send_stream`).
+
+        ``round_tag``: federated round index stamped into the frame's
+        metadata (``wire.ROUND_TAG_KEY``) — with pipelined rounds one
+        round's frames are still in flight while the next computes, and
+        the tag is what keeps receiver logs and the overlap runner's
+        same-round fallback attributable to the round that owns them.
         """
         return self.send_many(
             [dest_party], data, upstream_seq_id, downstream_seq_id,
-            stream=stream,
+            stream=stream, round_tag=round_tag,
         )[dest_party]
 
     def send_many(
@@ -452,6 +460,7 @@ class TransportManager:
         upstream_seq_id: Any,
         downstream_seq_id: Any,
         stream: Optional[str] = None,
+        round_tag: Optional[int] = None,
     ) -> Dict[str, LocalRef]:
         """Fan one value out to N parties — encode once, send concurrently.
 
@@ -469,6 +478,10 @@ class TransportManager:
         dests = list(dest_parties)
         out_refs: Dict[str, LocalRef] = {p: LocalRef() for p in dests}
         self.stats["send_op_count"] += len(dests)
+        send_meta = (
+            None if round_tag is None
+            else {wire.ROUND_TAG_KEY: str(round_tag)}
+        )
 
         def _poison_all(exc: BaseException) -> None:
             for p in dests:
@@ -534,6 +547,7 @@ class TransportManager:
                     cf = asyncio.run_coroutine_threadsafe(
                         client.send_data(bufs, str(upstream_seq_id),
                                          str(downstream_seq_id), crc=crc,
+                                         metadata=send_meta,
                                          stream=stream,
                                          stream_snapshot=snapshot),
                         self._loop,
@@ -568,9 +582,11 @@ class TransportManager:
                         out_refs[p].set_result(True)
                     except Exception as e:
                         logger.warning(
-                            "[%s] failed to send to %s (up=%s down=%s): %r",
+                            "[%s] failed to send to %s (up=%s down=%s%s): %r",
                             self._party, p, upstream_seq_id,
-                            downstream_seq_id, e,
+                            downstream_seq_id,
+                            "" if round_tag is None
+                            else f" round={round_tag}", e,
                         )
                         out_refs[p].set_result(False)
 

@@ -86,6 +86,16 @@ ND_ZERO_COPY_MIN_BYTES = 1 * 1024 * 1024
 # a shipped chunk is one writev unit.
 DELTA_CHUNK_BYTES = 4 * 1024 * 1024
 
+# Metadata key stamping a DATA frame with the federated round it belongs
+# to (pipelined rounds keep one round's aggregation in flight under the
+# next round's compute — the tag is what lets a receiver's logs and the
+# runner's fallback attribute a late or failed frame to the ROUND that
+# owns it, rather than silently folding it into whichever round is
+# current).  Rides the ordinary per-send metadata dict inside the JSON
+# header's "meta" field: no frame-layout change, but the key name is a
+# cross-party contract — fingerprinted by tool/check_wire_format.py.
+ROUND_TAG_KEY = "rnd"
+
 
 def pack_frame(
     msg_type: int,

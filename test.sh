@@ -22,11 +22,14 @@ JAX_PLATFORMS=cpu python tool/check_wire_format.py
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 
 # Fast bench smoke: drives the streaming-aggregation + delta-cache
-# pipeline AND the 4-party ring reduce-scatter round end-to-end over
-# real sockets (small bundles) so a transport/aggregation regression
-# fails CI, not the next bench round.  The ring section gates
-# coord_bytes_in_frac <= 0.4: the coordinator's share of cluster
-# ingress must stay at ~1/N (the hub pins it at ~0.5).
+# pipeline, the 4-party ring reduce-scatter round AND the pipelined
+# (overlap=True) round engine end-to-end over real sockets (small
+# bundles) so a transport/aggregation regression fails CI, not the
+# next bench round.  Gates: coord_bytes_in_frac <= 0.4 (the ring must
+# keep the coordinator's share of cluster ingress at ~1/N; the hub
+# pins it at ~0.5) and overlap_hidden_comm_frac >= 0.5 (the pipelined
+# engine must hide at least half the per-round comms wall under local
+# compute).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

@@ -17,6 +17,22 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache, shared by the pytest process AND the
+# spawned party subprocesses (env is inherited; jax reads these at
+# import).  Multi-party tests re-jit the SAME trainer/fold programs in
+# every fresh child — per-subprocess compiles dominate tier-1 wall time
+# (ROADMAP budget item), and with the cache N party children pay one
+# compile instead of N, and repeat runs pay none.  Concurrent writers
+# are safe: the cache writes via temp-file + rename, and a cache miss
+# (or corrupt read) falls back to a normal compile with a warning.
+# Per-uid path: a fixed shared /tmp dir would be owned by whichever user
+# ran first, silently turning every other user's cache writes into
+# warnings + full recompiles.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", f"/tmp/rayfed-jax-cache-{os.getuid()}"
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -121,8 +121,10 @@ def _stats_party_run(party, cluster):
     stats = fed.get_stats()
     if party == "alice":
         assert stats["send_op_count"] >= 1, stats
-        # Bytes are counted on ACK (async) — poll briefly.
-        deadline = time.time() + 10
+        # Bytes are counted on ACK (async) — poll.  Generous
+        # deadline: under full-suite load on a busy CI box the ACK can
+        # lag well past the 10s that suffices on an idle machine.
+        deadline = time.time() + 45
         while stats.get("send_bytes", 0) == 0 and time.time() < deadline:
             time.sleep(0.05)
             stats = fed.get_stats()

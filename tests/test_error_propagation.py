@@ -86,6 +86,17 @@ def test_remote_error_wire_roundtrip():
 # --- end-to-end: producer raises, consumer's fed.get raises -----------------
 
 
+# Failure-injection fixtures keep a tight retry ladder: what they assert
+# is how fast an error SURFACES, and with the default 5-attempt/65s
+# ladder the wall is dominated by poison/result pushes retrying against
+# peers that already shut down (inside fed.shutdown()'s wait_sending).
+TIGHT_RETRY = {
+    "maxAttempts": 3,
+    "initialBackoff": "0.2s",
+    "maxBackoff": "1s",
+}
+
+
 def run_producer_raises(party, cluster):
     import rayfed_tpu as fed
 
@@ -94,6 +105,7 @@ def run_producer_raises(party, cluster):
         cluster=cluster,
         party=party,
         recv_backstop_in_seconds=120,
+        cross_silo_retry_policy=TIGHT_RETRY,
     )
 
     @fed.remote
@@ -134,6 +146,7 @@ def run_actor_method_raises(party, cluster):
         cluster=cluster,
         party=party,
         recv_backstop_in_seconds=120,
+        cross_silo_retry_policy=TIGHT_RETRY,
     )
 
     @fed.remote
@@ -182,6 +195,7 @@ def run_peer_death(party, cluster):
         recv_backstop_in_seconds=300,
         peer_health_interval_in_seconds=0.5,
         peer_death_pings=2,
+        cross_silo_retry_policy=TIGHT_RETRY,
     )
 
     @fed.remote
@@ -237,6 +251,7 @@ def run_pipelined_round_failure(party, cluster):
         cluster=cluster,
         party=party,
         recv_backstop_in_seconds=300,
+        cross_silo_retry_policy=TIGHT_RETRY,
     )
     parties = ("alice", "bob", "carol")
 

@@ -4,6 +4,8 @@ example's ``run()`` in real per-party processes (same code path as
 in-process, so the files the docs point users at cannot silently drift
 from the tested behavior."""
 
+import pytest
+
 from tests.multiproc import run_parties
 
 from examples.fedavg_mnist import run as run_fedavg_example
@@ -17,10 +19,16 @@ def test_fedavg_mnist_example():
     run_parties(run_fedavg_example, ["alice", "bob"], args=(2,), timeout=240)
 
 
+# slow: ~24s each idle (subprocess JAX imports + model jit compiles),
+# and each duplicates a tier-1 e2e that asserts MORE — lora fedavg in
+# test_fl_lora.py, split-FL BERT in test_fl.py.  These two stay liveness
+# checks for the shipped example files, run with the slow tier.
+@pytest.mark.slow
 def test_lora_finetune_example():
     run_parties(run_lora_example, ["alice", "bob"], args=(1,), timeout=240)
 
 
+@pytest.mark.slow
 def test_split_fl_bert_example():
     run_parties(run_split_example, ["alice", "bob"], args=(2,), timeout=240)
 

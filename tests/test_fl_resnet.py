@@ -13,6 +13,7 @@ what's under test is the cross-party protocol, not conv throughput.
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from tests.multiproc import make_cluster, run_parties
 
@@ -101,5 +102,11 @@ def run_resnet_fedavg(party, cluster=RESNET_CLUSTER):
     fed.shutdown()
 
 
+# slow: heaviest tier-1 fixture (~55s idle: 4 subprocess JAX imports +
+# resnet jit compiles).  The 4-party coordinator round stays covered in
+# tier-1 by test_streaming_agg's fed-API round, the ring suite and the
+# overlap suite (toy models — same aggregation path, fraction of the
+# cost), and the resnet packed train step by test_packed_codec.
+@pytest.mark.slow
 def test_resnet_fedavg_4party_coordinator():
     run_parties(run_resnet_fedavg, PARTIES, args=(RESNET_CLUSTER,), timeout=300)

@@ -204,7 +204,18 @@ def _run_trainer_failure(party, cluster=FAIL_CLUSTER):
     from rayfed_tpu.exceptions import RemoteError
     from rayfed_tpu.fl import run_fedavg_rounds
 
-    fed.init(address="local", cluster=cluster, party=party)
+    # Tight retry ladder: this test asserts how fast the error SURFACES;
+    # with the default 5-attempt/65s ladder the wall is dominated by
+    # poison pushes retrying against the peer that already shut down
+    # (same rationale as test_error_propagation.TIGHT_RETRY).
+    fed.init(
+        address="local", cluster=cluster, party=party,
+        cross_silo_retry_policy={
+            "maxAttempts": 3,
+            "initialBackoff": "0.2s",
+            "maxBackoff": "1s",
+        },
+    )
 
     @fed.remote
     class Flaky:

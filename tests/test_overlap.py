@@ -1,0 +1,405 @@
+"""Pipelined (overlapped) federated rounds: fl.overlap + transport hooks.
+
+The multi-party tests assert the load-bearing contracts of the overlap
+engine: the pipelined result follows the DGA recurrence EXACTLY (the
+correction, fold and finalize kernels are all deterministic, so the
+expected bytes are computable in-process), ``overlap=False`` stays
+byte-identical to the synchronous streaming path, one-round pipelining
+degenerates to the synchronous result, and a mid-overlap ring abort is
+re-aggregated — same round — over the coordinator topology on every
+controller (PR 3's fallback contract, now under overlap).  In-process
+tests cover the async send future, round tagging, the DGA kernel and
+driver validation.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.multiproc import get_free_ports, make_cluster, run_parties
+
+D = 96  # model width of the toy quadratic trainers
+
+
+def _make_trainer_cls(fed):
+    """Deterministic quadratic-pull trainer, packed wire contract."""
+    from rayfed_tpu.fl import compression as C
+
+    @fed.remote
+    class Quad:
+        def __init__(self, seed):
+            self._c = jax.random.normal(jax.random.PRNGKey(seed), (D,))
+
+        def train(self, params):
+            x = C.decompress(params, jnp.float32)["x"]
+            for _ in range(2):
+                x = x - 0.25 * (x - self._c)
+            return C.compress({"x": x}, packed=True)
+
+    return Quad
+
+
+def _local_train(x_packed, seed):
+    """The identical math Quad.train applies, runnable in-process."""
+    from rayfed_tpu.fl import compression as C
+
+    c = jax.random.normal(jax.random.PRNGKey(seed), (D,))
+    x = C.decompress(x_packed, jnp.float32)["x"]
+    for _ in range(2):
+        x = x - 0.25 * (x - c)
+    return C.compress({"x": x}, packed=True)
+
+
+OVERLAP_CLUSTER = make_cluster(["alice", "bob"])
+
+
+def _run_overlap_two_party(party, cluster):
+    """overlap=True follows the DGA recurrence bit-exactly; the
+    synchronous path is untouched; rounds=1 overlap == sync."""
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import compression as C
+    from rayfed_tpu.fl import run_fedavg_rounds
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.overlap import dga_correct
+
+    fed.init(address="local", cluster=cluster, party=party)
+    Quad = _make_trainer_cls(fed)
+    parties = ("alice", "bob")
+    seeds = {p: i + 1 for i, p in enumerate(parties)}
+    trainers = {p: Quad.party(p).remote(seeds[p]) for p in parties}
+    params = {"x": jnp.linspace(-1.0, 1.0, D)}
+    rounds = 3
+
+    timings = []
+    out = run_fedavg_rounds(
+        trainers, params, rounds=rounds, compress_wire=True,
+        packed_wire=True, overlap=True, timings=timings,
+    )
+
+    # The expected bytes, computed in-process: every kernel on the fed
+    # path (compress, train, dga_correct, the packed fold + finalize) is
+    # deterministic, so the pipelined run must reproduce this exactly.
+    inputs = {p: C.compress(params, packed=True) for p in parties}
+    agg = None
+    for r in range(rounds):
+        u = {p: _local_train(inputs[p], seeds[p]) for p in parties}
+        if r == 0:
+            contribs = u
+        else:
+            contribs = {
+                p: dga_correct(agg, u[p], inputs[p]) for p in parties
+            }
+        agg = packed_weighted_sum([contribs[p] for p in parties])
+        inputs = contribs
+    expected = C.decompress(agg)
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(expected["x"]))
+
+    # Per-round timing breakdown: one complete record per round, and
+    # with sub-ms comms under multi-ms compute SOME round must have hidden
+    # comms (the whole point of the overlap).
+    assert len(timings) == rounds
+    for rec in timings:
+        assert set(rec) == {"local_s", "push_s", "agg_s", "hidden_s"}
+        assert rec["agg_s"] >= 0.0 and rec["hidden_s"] >= 0.0
+
+    # overlap=False (streaming) stays byte-identical to the synchronous
+    # recurrence — the refactor must not have moved the sync path.
+    sync_t = []
+    sync_out = run_fedavg_rounds(
+        trainers, params, rounds=rounds, compress_wire=True,
+        packed_wire=True, streaming_agg=True, timings=sync_t,
+    )
+    inp = C.compress(params, packed=True)
+    for r in range(rounds):
+        u = {p: _local_train(inp, seeds[p]) for p in parties}
+        inp = packed_weighted_sum([u[p] for p in parties])
+    expected_sync = C.decompress(inp)
+    np.testing.assert_array_equal(
+        np.asarray(sync_out["x"]), np.asarray(expected_sync["x"])
+    )
+    assert len(sync_t) == rounds
+    assert all(rec["hidden_s"] == 0.0 for rec in sync_t)
+
+    # One round has nothing to overlap: pipelined == synchronous bytes.
+    one_overlap = run_fedavg_rounds(
+        trainers, params, rounds=1, compress_wire=True, packed_wire=True,
+        overlap=True,
+    )
+    one_sync = run_fedavg_rounds(
+        trainers, params, rounds=1, compress_wire=True, packed_wire=True,
+        streaming_agg=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(one_overlap["x"]), np.asarray(one_sync["x"])
+    )
+    fed.shutdown()
+
+
+def test_overlap_two_party_matches_dga_recurrence():
+    run_parties(
+        _run_overlap_two_party, ["alice", "bob"], args=(OVERLAP_CLUSTER,),
+        timeout=300,
+    )
+
+
+FAULT_CLUSTER = make_cluster(["alice", "bob", "carol"])
+
+
+def _run_overlap_ring_fault(party, cluster):
+    """A ring abort while round 1 is in flight under round 2's compute:
+    every controller sees RingRoundError, re-aggregates the SAME round
+    over the coordinator topology, and the final model equals an
+    overlap run that never used the ring at all (ring == coordinator ==
+    fallback, byte-identical)."""
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import ring as ring_mod
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    fed.init(address="local", cluster=cluster, party=party)
+    Quad = _make_trainer_cls(fed)
+    parties = ("alice", "bob", "carol")
+    params = {"x": jnp.zeros((D,))}
+
+    def run(mode):
+        trainers = {
+            p: Quad.party(p).remote(i + 1) for i, p in enumerate(parties)
+        }
+        kw = (
+            {"mode": "ring", "ring_chunk_elems": 16}
+            if mode == "ring"
+            else {}
+        )
+        return run_fedavg_rounds(
+            trainers, params, rounds=3, compress_wire=True,
+            packed_wire=True, overlap=True, **kw,
+        )
+
+    # Only bob faults, at the reduce-scatter of its 2nd ring round —
+    # alice/carol must learn of the abort through the poison cascade.
+    calls = {"n": 0}
+
+    def hook(phase):
+        if phase == "rs" and party == "bob":
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise ConnectionError("injected mid-overlap ring failure")
+
+    ring_mod._fault_hook = hook
+    try:
+        final_ring = run("ring")
+    finally:
+        ring_mod._fault_hook = None
+    assert ring_mod.RING_STATS["rounds_aborted"] >= 1
+    assert ring_mod.RING_STATS["fallback_rounds"] >= 1
+    assert ring_mod.RING_STATS["rounds_completed"] >= 2
+
+    final_coord = run("coordinator")
+    np.testing.assert_array_equal(
+        np.asarray(final_ring["x"]), np.asarray(final_coord["x"])
+    )
+    fed.shutdown()
+
+
+def test_overlap_ring_fault_falls_back_same_round():
+    run_parties(
+        _run_overlap_ring_fault, ["alice", "bob", "carol"],
+        args=(FAULT_CLUSTER,), timeout=300,
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-process: transport hooks (async send future, round tagging)
+# ---------------------------------------------------------------------------
+
+
+def _self_manager(party="alice", **job_kw):
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.transport.manager import TransportManager
+
+    (port,) = get_free_ports(1)
+    job_kw.setdefault("device_put_received", False)
+    mgr = TransportManager(
+        ClusterConfig(
+            parties={party: PartyConfig(address=f"127.0.0.1:{port}")},
+            current_party=party,
+        ),
+        JobConfig(**job_kw),
+    )
+    mgr.start()
+    return mgr
+
+
+def test_send_data_async_resolves_on_ack():
+    from rayfed_tpu.transport import wire
+
+    mgr = _self_manager()
+    try:
+        recv_ref = mgr.recv("alice", "async", "0")
+        client = mgr._get_client("alice")
+        payload = wire.encode_payload({"x": np.arange(64)})
+        ref = client.send_data_async(payload, "async", "0")
+        assert ref.resolve(timeout=30) == "OK"
+        out = recv_ref.resolve(timeout=30)
+        np.testing.assert_array_equal(out["x"], np.arange(64))
+    finally:
+        mgr.stop()
+
+
+def test_send_data_async_errs_on_failure():
+    """Dead peer: the completion future must ERR (after retries), not
+    hang or swallow to a bool."""
+    from rayfed_tpu.config import (
+        ClusterConfig,
+        JobConfig,
+        PartyConfig,
+        RetryPolicy,
+    )
+    from rayfed_tpu.transport import wire
+    from rayfed_tpu.transport.client import SendError
+    from rayfed_tpu.transport.manager import TransportManager
+
+    port_a, port_dead = get_free_ports(2)
+    mgr = TransportManager(
+        ClusterConfig(
+            parties={
+                "alice": PartyConfig(address=f"127.0.0.1:{port_a}"),
+                "ghost": PartyConfig(address=f"127.0.0.1:{port_dead}"),
+            },
+            current_party="alice",
+        ),
+        JobConfig(
+            device_put_received=False,
+            retry_policy=RetryPolicy(
+                max_attempts=2, initial_backoff_s=0.05, max_backoff_s=0.1
+            ),
+        ),
+    )
+    mgr.start()
+    try:
+        client = mgr._get_client("ghost")
+        ref = client.send_data_async(
+            wire.encode_payload({"x": 1}), "dead", "0"
+        )
+        with pytest.raises((SendError, OSError, ConnectionError)):
+            ref.resolve(timeout=30)
+    finally:
+        mgr.stop()
+
+
+def test_send_data_async_requires_bound_loop():
+    from rayfed_tpu.config import RetryPolicy
+    from rayfed_tpu.transport.client import TransportClient
+
+    client = TransportClient(
+        "a", "b", "127.0.0.1:1", RetryPolicy(), 1.0, 1 << 20,
+        checksum=False,
+    )
+    with pytest.raises(RuntimeError, match="event loop"):
+        client.send_data_async([], "u", "d")
+
+
+def test_round_tag_rides_frame_metadata():
+    from rayfed_tpu.transport import wire
+
+    mgr = _self_manager()
+    try:
+        assert mgr.send(
+            "alice", {"x": 7}, "tagged", "0", round_tag=12
+        ).resolve(timeout=30)
+        msg = asyncio.run_coroutine_threadsafe(
+            mgr._mailbox.get("tagged", "0", timeout_s=30), mgr._loop
+        ).result(timeout=30)
+        assert msg.metadata[wire.ROUND_TAG_KEY] == "12"
+
+        # Untagged sends stay untagged (no stray key in the metadata).
+        assert mgr.send("alice", {"x": 8}, "untagged", "0").resolve(
+            timeout=30
+        )
+        msg = asyncio.run_coroutine_threadsafe(
+            mgr._mailbox.get("untagged", "0", timeout_s=30), mgr._loop
+        ).result(timeout=30)
+        assert wire.ROUND_TAG_KEY not in msg.metadata
+    finally:
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# In-process: DGA correction kernel + driver validation + comms lane
+# ---------------------------------------------------------------------------
+
+
+def test_dga_correct_recurrence_and_passthrough():
+    from rayfed_tpu.fl import compression as C
+    from rayfed_tpu.fl.overlap import dga_correct
+
+    base = C.compress(
+        {"w": jnp.arange(8.0), "n": np.int32(4)}, packed=True
+    )
+    cur = C.compress(
+        {"w": jnp.arange(8.0) + 2.0, "n": np.int32(6)}, packed=True
+    )
+    agg = C.compress(
+        {"w": jnp.arange(8.0) * 0.5, "n": np.int32(10)}, packed=True
+    )
+    out = dga_correct(agg, cur, base)
+    # agg + (cur - base), computed in f32 then cast back to the wire
+    # dtype — for these exactly-representable values, exact.
+    np.testing.assert_array_equal(
+        np.asarray(out.buf, np.float32),
+        np.asarray(agg.buf, np.float32) + 2.0,
+    )
+    # Passthrough (non-float) leaves follow the same recurrence.
+    assert int(out.passthrough[0]) == 10 + (6 - 4)
+
+
+def test_dga_correct_rejects_mismatched_specs():
+    from rayfed_tpu.fl import compression as C
+    from rayfed_tpu.fl.overlap import dga_correct
+
+    a = C.compress({"w": jnp.ones(4)}, packed=True)
+    b = C.compress({"w": jnp.ones(8)}, packed=True)
+    with pytest.raises(ValueError, match="spec"):
+        dga_correct(a, b, b)
+    with pytest.raises(TypeError, match="PackedTree"):
+        dga_correct({"w": jnp.ones(4)}, a, a)
+
+
+def test_overlap_driver_validation():
+    from rayfed_tpu.fl import run_fedavg_rounds, server_sgd
+
+    trainers = {"a": None, "b": None}
+    with pytest.raises(ValueError, match="overlap"):
+        run_fedavg_rounds(trainers, {}, rounds=1, overlap=True)
+    with pytest.raises(ValueError, match="incompatible"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, overlap=True, compress_wire=True,
+            packed_wire=True, server_opt=server_sgd(lr=1.0),
+        )
+    with pytest.raises(ValueError, match="incompatible"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, overlap=True, compress_wire=True,
+            packed_wire=True, error_feedback=True,
+        )
+    with pytest.raises(ValueError, match="ring_chunk_elems"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, compress_wire=True, packed_wire=True,
+            ring_chunk_elems=64,
+        )
+
+
+def test_comms_lane_binds_and_shuts_down():
+    from rayfed_tpu.executor import CommsLane
+
+    seen = []
+    lane = CommsLane(bind_runtime_fn=lambda: seen.append("bound"))
+    assert lane.submit(lambda a, b: a + b, 2, 3).resolve(timeout=10) == 5
+    assert seen == ["bound"]
+    boom = lane.submit(lambda: 1 / 0)
+    with pytest.raises(ZeroDivisionError):
+        boom.resolve(timeout=10)
+    lane.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        lane.submit(lambda: None)

@@ -128,6 +128,11 @@ def compute_fingerprint() -> str:
             "delta_manifest_schema": _schema(delta_manifest),
             "stream_header_keys": ["stm", "ccsz", "ccrc", "dlt"],
             "delta_chunk_bytes": wire.DELTA_CHUNK_BYTES,
+            # Round tagging (pipelined rounds): the metadata key naming
+            # the federated round a frame belongs to.  Rides the
+            # ordinary "meta" dict — no frame-layout change, but the key
+            # name is a cross-party contract like the stream headers.
+            "round_tag_key": wire.ROUND_TAG_KEY,
             "ring_stripe_schema": _schema(stripe_manifest),
             "ring_stripe_version": ring.RING_STRIPE_VERSION,
         },
