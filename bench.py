@@ -352,11 +352,14 @@ def _run_push_bench(_party: str, result_q) -> None:
     from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
     from rayfed_tpu.transport.manager import TransportManager
 
-    def mk(party, device_put_received):
+    def mk(party, device_put_received, options=None):
+        pc = {"address": "127.0.0.1:13050"}, {"address": "127.0.0.1:13051"}
+        if options:
+            pc = tuple(dict(d, transport_options=options) for d in pc)
         cc = ClusterConfig(
             parties={
-                "alice": PartyConfig.from_dict({"address": "127.0.0.1:13050"}),
-                "bob": PartyConfig.from_dict({"address": "127.0.0.1:13051"}),
+                "alice": PartyConfig.from_dict(pc[0]),
+                "bob": PartyConfig.from_dict(pc[1]),
             },
             current_party=party,
         )
@@ -408,6 +411,41 @@ def _run_push_bench(_party: str, result_q) -> None:
 
     wire_gbps = run(device_put_received=False, steps=6)
     reshard_gbps = run(device_put_received=True, steps=4)
+
+    # Multi-rail striping (wire v4): ONE payload's chunks fanned over
+    # the per-destination connection pool vs pinned to a single rail.
+    # On a real multi-core sender with a fat link the rails pipeline
+    # d2h/CRC/writev; on a CPU-bound 1-2 core loopback box every rail
+    # shares the same core so the numbers converge — recorded, not
+    # gated (docs/source/send_path.rst covers when striping is a wash).
+    def run_rails(rails, steps=3, reps=2):
+        # stripe_rails explicit: the host-adaptive default turns
+        # striping off on few-core hosts, and this probe measures it.
+        a = mk("alice", False, {"connections_per_peer": rails,
+                                "stripe_rails": rails})
+        b = mk("bob", False)
+        a.start()
+        b.start()
+        a.send("bob", xs, "warmr", "0").resolve()
+        b.recv("alice", "warmr", "0").resolve()
+        best_dt = float("inf")
+        for rep in range(reps):
+            refs = []
+            t0 = time.perf_counter()
+            for i in range(steps):
+                refs.append(a.send("bob", xs, f"mr{rep}-{i}", "0"))
+                b.recv("alice", f"mr{rep}-{i}", "0").resolve()
+            dt = time.perf_counter() - t0
+            results = [r.resolve(timeout=60) for r in refs]
+            if not all(results):
+                raise RuntimeError(f"multirail push failed: {results}")
+            best_dt = min(best_dt, dt)
+        a.stop()
+        b.stop()
+        return x.nbytes * steps / best_dt / 1e9
+
+    multirail_gbps = run_rails(4)
+    singlerail_gbps = run_rails(1)
 
     # Packed-tree codec push: a ResNet-scale many-leaf float tree (64
     # leaves, 45 MB f32) compressed to bf16 and pushed end-to-end
@@ -478,7 +516,7 @@ def _run_push_bench(_party: str, result_q) -> None:
         (
             "push",
             (wire_gbps, reshard_gbps, packed_gbps, perleaf_gbps,
-             overlap_frac),
+             overlap_frac, multirail_gbps, singlerail_gbps),
         )
     )
 
@@ -656,6 +694,234 @@ def _run_stream_agg_bench(_party: str, result_q) -> None:
                 "bundle_mb": bundle_bytes / 1e6,
             },
         )
+    )
+
+
+def _run_send_path_bench(_party: str, result_q) -> None:
+    """FedAvg coordinator send-path probe — the ISSUE-5 gap gate.
+
+    The r05 verdict's top perf finding: the FedAvg round used the
+    transport at a quarter of its demonstrated capacity
+    (``cross_party_wire_GBps`` 0.216 vs the push bench's 0.904) because
+    the coordinator's send path burned 454 ms of encode/checksum/
+    loop-handoff against 167 ms of actual socket read (2.7× overhead).
+    This section reproduces exactly that exchange shape — (N-1)
+    contributions into the coordinator, the aggregate broadcast back out
+    — with in-process TransportManagers over real loopback sockets and
+    packed bf16 bundles large enough to engage the arena path (and,
+    on hosts with the cores for it, multi-rail striping), and reports:
+
+    - ``cross_party_wire_GBps``: the coordinator's session bytes over
+      its round comms wall (contributions-in + broadcast-out phases) —
+      the FedAvg-path wire rate.
+    - ``push_capability_GBps``: sequential single-payload pushes of the
+      SAME bundle on the same box at the same moment — the transport's
+      demonstrated capacity, the yardstick the r05 verdict compared
+      against (0.904 there).
+    - ``wire_vs_push_capability``: their ratio — THE gap number.  r05
+      sat at 0.216/0.904 = 0.24 (the "4× gap"); test.sh gates >= 0.5
+      ("closed to <= 2×").  Relative to the same-box capability, like
+      the other smoke gates (coord_bytes_in_frac, hidden_comm_frac),
+      because absolute GB/s tracks the host, not the code: the r05
+      numbers' host sustains ~5× this CI box.
+    - ``send_vs_read_wall_ratio``: broadcast-out phase wall over
+      contributions-in phase wall (median of rounds) — symmetric byte
+      volumes, so with the full-payload serialization barrier gone this
+      sits near 1.0 (gated <= 1.5; the r05 shape of the same quantity
+      was the 2.7× send/read session imbalance).
+    - ``coord_wire_read_ms`` / ``coord_send_path_ms`` (summed transfer-
+      log sessions, the r05 decomposition — sessions of concurrent
+      peers overlap, so these sums exceed wall) and their ratio
+      ``send_path_overhead_ratio``, recorded for continuity.
+    - ``send_path_breakdown_ms``: the per-stage split (encode/d2h/crc/
+      loop_wait/socket) from ``get_stats`` — where any reopened gap
+      lives.
+    """
+    import numpy as np
+    import jax
+
+    from rayfed_tpu import metrics
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.transport.manager import TransportManager
+
+    smoke = bool(os.environ.get("RAYFED_BENCH_SMOKE"))
+    parties = ("alice", "bob", "carol", "dave")
+    ports = {p: 13160 + i for i, p in enumerate(parties)}
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                p: PartyConfig.from_dict({"address": f"127.0.0.1:{ports[p]}"})
+                for p in parties
+            },
+            current_party=party,
+        )
+        return TransportManager(
+            cc,
+            JobConfig(device_put_received=False, zero_copy_host_arrays=True),
+        )
+
+    mgrs = {p: mk(p) for p in parties}
+    for m in mgrs.values():
+        m.start()
+
+    if smoke:
+        import jax.numpy as jnp
+
+        # ~24 MB bf16 packed bundle: 6 wire chunks, stripes across the
+        # pool — big enough to be wire-bound, small enough for CI.
+        tree = {
+            f"l{i}": jnp.arange(3_000_000, dtype=jnp.float32) * 1e-6 + i
+            for i in range(4)
+        }
+        rounds = 3
+    else:
+        from rayfed_tpu.models import resnet
+
+        cfg = resnet.resnet18(num_classes=10)
+        tree = resnet.init_resnet(jax.random.PRNGKey(0), cfg)
+        rounds = 3
+    bundle = fl_comp.compress(tree, packed=True)
+    jax.block_until_ready(bundle.buf)
+    bundle_bytes = np.asarray(bundle.buf).nbytes
+    peers = [p for p in parties if p != "alice"]
+    # Distinct per-peer contributions (realistic: every peer's bytes
+    # differ), pre-built so construction stays outside the window.
+    contribs = {
+        p: fl_comp.PackedTree(
+            np.asarray(bundle.buf).copy(), bundle.passthrough, bundle.spec
+        )
+        for p in peers
+    }
+
+    def do_round(r):
+        t0 = time.perf_counter()
+        send_refs = [
+            mgrs[p].send("alice", contribs[p], f"c{r}-{p}", "0")
+            for p in peers
+        ]
+        got = [
+            mgrs["alice"].recv(p, f"c{r}-{p}", "0").resolve(timeout=300)
+            for p in peers
+        ]
+        t_in = time.perf_counter()
+        bcast = mgrs["alice"].send_many(peers, got[0], f"b{r}", "0")
+        for p in peers:
+            mgrs[p].recv("alice", f"b{r}", "0").resolve(timeout=300)
+        for ref in send_refs + list(bcast.values()):
+            if not ref.resolve(timeout=300):
+                raise RuntimeError("send-path bench send failed")
+        t_end = time.perf_counter()
+        return t_in - t0, t_end - t_in
+
+    do_round(0)  # warmup: connections, codec pools, first fetches
+    log = metrics.get_transfer_log()
+    total0 = log.total_recorded
+    stats0 = mgrs["alice"].get_stats()
+    bk0 = stats0["send_path_breakdown_ms"]
+    # Best-of-reps like every wire bench here: a shared box's noise must
+    # not fail the gate, the capability number is the max over windows.
+    comms_wall = float("inf")
+    wall_ratios = []
+    for r in range(1, rounds + 1):
+        in_s, out_s = do_round(r)
+        comms_wall = min(comms_wall, in_s + out_s)
+        wall_ratios.append(out_s / in_s)
+    wall_ratios.sort()
+    wall_ratio = wall_ratios[len(wall_ratios) // 2]  # median
+    recs, complete = log.records_since(total0)
+    stats1 = mgrs["alice"].get_stats()
+    bk1 = stats1["send_path_breakdown_ms"]
+
+    # In-situ capability yardstick: sequential single-payload pushes of
+    # the same bundle, alice → bob, wall-clocked — what the wire
+    # demonstrably sustains on THIS box right now (the r05 verdict's
+    # 0.904 came from the equivalent dedicated push bench).
+    cap_wall = float("inf")
+    for rep in range(2):
+        t0 = time.perf_counter()
+        for i in range(3):
+            ref = mgrs["alice"].send("bob", bundle, f"cap{rep}-{i}", "0")
+            mgrs["bob"].recv("alice", f"cap{rep}-{i}", "0").resolve(
+                timeout=300
+            )
+            if not ref.resolve(timeout=300):
+                raise RuntimeError("capability probe send failed")
+        cap_wall = min(cap_wall, time.perf_counter() - t0)
+    cap_gbps = 3 * bundle_bytes / cap_wall / 1e9
+    for m in mgrs.values():
+        m.stop()
+    if not complete:
+        raise RuntimeError("transfer log ring evicted the bench window")
+    # The r05 decomposition for continuity: summed transfer-log wire
+    # sessions — contributions read in ("c*" recv records land on
+    # alice's manager), aggregate broadcast out ("b*" send records are
+    # alice's).  Sessions of concurrent peers overlap, so these sums
+    # exceed the wall above; the overhead RATIO is what they gate.
+    read_s = sum(
+        r.seconds for r in recs
+        if r.direction == "recv" and r.up_id.startswith("c")
+    )
+    send_s = sum(
+        r.seconds for r in recs
+        if r.direction == "send" and r.up_id.startswith("b")
+    )
+    coord_bytes = 2 * len(peers) * bundle_bytes
+    wire_gbps = coord_bytes / comms_wall / 1e9
+    result_q.put(
+        (
+            "send_path",
+            {
+                "wire_gbps": wire_gbps,
+                "cap_gbps": cap_gbps,
+                "vs_cap": wire_gbps / cap_gbps if cap_gbps > 0 else None,
+                "wall_ratio": wall_ratio,
+                "read_ms": read_s / rounds * 1e3,
+                "send_ms": send_s / rounds * 1e3,
+                "overhead_ratio": send_s / read_s if read_s > 0 else None,
+                "bundle_mb": bundle_bytes / 1e6,
+                "breakdown_ms": {
+                    k: round(bk1[k] - bk0[k], 2) for k in bk1
+                },
+                "striped_payloads": (
+                    stats1["send_striped_payloads"]
+                    - stats0["send_striped_payloads"]
+                ),
+            },
+        )
+    )
+
+
+def _fill_send_path_extra(extra: dict, s: dict) -> None:
+    # cross_party_wire_GBps is the gateable FedAvg-path rate; the full
+    # resnet e2e section later overwrites it with its own (compute-
+    # embedded) measurement, so the probe's number also keeps its own
+    # key.
+    extra["cross_party_wire_GBps"] = round(s["wire_gbps"], 3)
+    extra["send_path_wire_GBps"] = round(s["wire_gbps"], 3)
+    extra["push_capability_GBps"] = round(s["cap_gbps"], 3)
+    extra["wire_vs_push_capability"] = (
+        round(s["vs_cap"], 3) if s["vs_cap"] else None
+    )
+    extra["send_vs_read_wall_ratio"] = round(s["wall_ratio"], 3)
+    extra["coord_wire_read_ms"] = round(s["read_ms"], 2)
+    extra["coord_send_path_ms"] = round(s["send_ms"], 2)
+    extra["send_path_overhead_ratio"] = (
+        round(s["overhead_ratio"], 3) if s["overhead_ratio"] else None
+    )
+    extra["send_path_breakdown_ms"] = s["breakdown_ms"]
+    extra["send_path_striped_payloads"] = s["striped_payloads"]
+    _log(
+        f"  send path: {s['wire_gbps']:.3f} GB/s FedAvg-path wire vs "
+        f"{s['cap_gbps']:.3f} GB/s push capability "
+        f"({s['vs_cap']:.2f} of capability; r05 gap was 0.24) — "
+        f"{s['bundle_mb']:.1f} MB bundles, {s['striped_payloads']} "
+        f"striped payloads; send/read phase-wall ratio "
+        f"{s['wall_ratio']:.2f} (r05 session imbalance was 2.7); "
+        f"coordinator read {s['read_ms']:.1f} ms vs send "
+        f"{s['send_ms']:.1f} ms session sum per round "
+        f"({s['overhead_ratio']:.2f}x); breakdown {s['breakdown_ms']}"
     )
 
 
@@ -1549,13 +1815,19 @@ def _llama_mfu_breakdown(cfg, batch, seq, step_time) -> dict:
     EXACT bench shapes (same slope methodology as the step itself) and
     scaled by layer count: the flash-attention core (fwd+bwd), the
     layer matmuls (qkv/o projections + SwiGLU FFN, fwd+bwd), the
-    lm_head (fwd+bwd), and the full-tree Adam update.  The residual
-    (step − sum) is remat recompute + norms/rope/elementwise + scan
-    plumbing.  Single chip, so no collectives line.  The probes are a
-    shape model, not a trace: components measured in isolation can
-    overlap differently inside the fused step — good to ~10%, which is
-    enough to tell "attention is the ceiling" from "the optimizer eats
-    15%".
+    lm_head (fwd+bwd), the full-tree Adam update, the norms + RoPE
+    elementwise (fwd+bwd), and the remat recompute (one full extra
+    layer FORWARD per layer — under ``remat_policy="dots"`` the
+    backward replays the whole layer forward, since every activation
+    dot has batch dims and is therefore not saved).  The residual
+    ``llama_other_ms`` (step − sum) is scan plumbing + embed/final-norm
+    + dispatch gaps — the r05 verdict flagged the then-unattributed
+    63.8 ms (27% of the step) as a blind spot; the two named spans
+    above are that attribution.  Single chip, so no collectives line.
+    The probes are a shape model, not a trace: components measured in
+    isolation can overlap differently inside the fused step — good to
+    ~10%, which is enough to tell "attention is the ceiling" from "the
+    optimizer eats 15%".
     """
     import jax.numpy as jnp
 
@@ -1701,17 +1973,83 @@ def _llama_mfu_breakdown(cfg, batch, seq, step_time) -> dict:
 
     adam_s = slope(build_adam, mk_adam, n_short=4, n_long=48)
 
+    # 5. Norms + RoPE elementwise (fwd+bwd), x L — the named span for
+    # part of what r05 lumped into "other".
+    g_norm1 = jnp.ones((D,), dt)
+    g_norm2 = jnp.ones((D,), dt)
+    cos_t, sin_t = _llama.rope_tables(
+        jnp.arange(T), Dh, cfg.rope_theta
+    )
+    KV = cfg.num_kv_heads
+
+    def build_norms_rope():
+        def fwd(x):
+            a = _llama._rms_norm(x, g_norm1, cfg.rms_eps)
+            b2 = _llama._rms_norm(x, g_norm2, cfg.rms_eps)
+            q = _llama.apply_rope(
+                x.reshape(B, T, H, Dh), cos_t, sin_t
+            )
+            k = _llama.apply_rope(
+                x[..., : KV * Dh].reshape(B, T, KV, Dh), cos_t, sin_t
+            )
+            return (
+                jnp.sum(a.astype(jnp.float32) ** 2)
+                + jnp.sum(b2.astype(jnp.float32) ** 2)
+                + jnp.sum(q.astype(jnp.float32) ** 2)
+                + jnp.sum(k.astype(jnp.float32) ** 2)
+            )
+
+        def body(x):
+            return jax.grad(fwd)(x).astype(dt)
+
+        return body
+
+    norms_s = slope(build_norms_rope, mk_x, n_short=4, n_long=256) * L
+
+    # 6. Remat recompute: ONE extra full-layer forward per layer — the
+    # price of fitting 1B params + Adam in HBM.  Probed as the real
+    # layer forward (llama._layer_fwd: norm→qkv→RoPE→GQA flash→out→
+    # MLP) at the bench shapes; under the "dots" policy every
+    # activation dot has batch dims and is recomputed in the backward.
+    lp_probe = {
+        "attn_norm": jnp.ones((D,), dt),
+        "mlp_norm": jnp.ones((D,), dt),
+        "wq": w["wq"], "wk": w["wk"], "wv": w["wv"], "wo": w["wo"],
+        "w_gate": w["w1"], "w_up": w["w3"], "w_down": w["w2"],
+    }
+
+    def build_layer_fwd():
+        def body(x):
+            out, _kv = _llama._layer_fwd(
+                x, lp_probe, cfg, cos_t, sin_t, flash_attention, B, T
+            )
+            return out.astype(dt)
+
+        return body
+
+    remat_s = (
+        slope(build_layer_fwd, mk_x, n_short=4, n_long=64) * L
+        if cfg.remat
+        else 0.0
+    )
+
     # Probes are isolation measurements (~10% error, no overlap
     # credit) — a small overshoot past the step time clamps to 0.
-    other_s = max(step_time - attn_s - matmul_s - head_s - adam_s, 0.0)
+    other_s = max(
+        step_time - attn_s - matmul_s - head_s - adam_s - norms_s
+        - remat_s,
+        0.0,
+    )
     _log(
         "  mfu breakdown (shape-model probes, per step):\n"
         f"    attention core (flash, fwd+bwd) {attn_s*1e3:7.1f} ms ({attn_s/step_time:5.1%})\n"
         f"    layer matmuls (qkv/o + ffn)     {matmul_s*1e3:7.1f} ms ({matmul_s/step_time:5.1%})\n"
         f"    lm_head                         {head_s*1e3:7.1f} ms ({head_s/step_time:5.1%})\n"
         f"    adam update                     {adam_s*1e3:7.1f} ms ({adam_s/step_time:5.1%})\n"
-        f"    other (remat recompute, norms,  {other_s*1e3:7.1f} ms ({other_s/step_time:5.1%})\n"
-        f"      rope, scan plumbing, gaps)"
+        f"    norms + rope (fwd+bwd)          {norms_s*1e3:7.1f} ms ({norms_s/step_time:5.1%})\n"
+        f"    remat recompute (layer fwd x L) {remat_s*1e3:7.1f} ms ({remat_s/step_time:5.1%})\n"
+        f"    other (scan plumbing, embeds,   {other_s*1e3:7.1f} ms ({other_s/step_time:5.1%})\n"
+        f"      dispatch gaps)"
     )
     # Per-layer counted matmul FLOPs at nominal peak — the yardstick
     # for whether the measured per-layer time is a kernel gap.
@@ -1724,8 +2062,9 @@ def _llama_mfu_breakdown(cfg, batch, seq, step_time) -> dict:
         f"ms/layer vs {layer_peak_ms:.1f} ms of counted FLOPs at nominal "
         f"peak ({layer_peak_ms/(matmul_s/L*1e3):.0%} of peak), so the MFU "
         f"number is structural, not a kernel gap: the MFU numerator "
-        f"counts only model FLOPs while {other_s/step_time:.0%} of the "
-        f"step is remat recompute + elementwise ('dots' remat is the "
+        f"counts only model FLOPs while "
+        f"{(remat_s + norms_s)/step_time:.0%} of the step is remat "
+        f"recompute + norm/rope elementwise ('dots' remat is the "
         f"price of fitting 1B params + Adam on one 16 GB chip) and "
         f"{adam_s/step_time:.0%} is the memory-bound Adam update.  "
         f"Raising MFU here means spending HBM on less remat, not faster "
@@ -1736,6 +2075,8 @@ def _llama_mfu_breakdown(cfg, batch, seq, step_time) -> dict:
         "llama_matmul_ms": round(matmul_s * 1e3, 1),
         "llama_head_ms": round(head_s * 1e3, 1),
         "llama_adam_ms": round(adam_s * 1e3, 1),
+        "llama_norms_rope_ms": round(norms_s * 1e3, 1),
+        "llama_remat_ms": round(remat_s * 1e3, 1),
         "llama_other_ms": round(other_s * 1e3, 1),
     }
 
@@ -2360,6 +2701,11 @@ def main() -> None:
                 timeout=420,
             )
             _fill_overlap_extra(extra, ores)
+        with _section(extra, "send_path"):
+            _log("coordinator send-path smoke (4-party hub, striped "
+                 "bundles, arena + multi-rail)...")
+            sp = _one_child("_run_send_path_bench", ndev=1, timeout=420)
+            _fill_send_path_extra(extra, sp)
         record = {
             "metric": "cross_party_stream_agg_GBps",
             "value": extra.get("cross_party_stream_agg_GBps", 0.0),
@@ -2373,6 +2719,7 @@ def main() -> None:
             "stream_agg_error" in extra
             or "ring_agg_error" in extra
             or "overlap_error" in extra
+            or "send_path_error" in extra
         ):
             raise SystemExit(1)
         # CI gate (test.sh): the ring must actually de-bottleneck the
@@ -2393,6 +2740,31 @@ def main() -> None:
             _log(
                 f"overlap smoke gate FAILED: "
                 f"overlap_hidden_comm_frac={hfrac} (must be >= 0.5)"
+            )
+            raise SystemExit(1)
+        # CI gates (test.sh): the r05 send-path gap must stay closed.
+        # (1) The FedAvg exchange must sustain at least HALF of the
+        # same-box demonstrated push capability (r05 sat at 0.24 — the
+        # "4× gap"; relative to in-situ capability because absolute
+        # GB/s tracks the host, not the code).
+        vs_cap = extra.get("wire_vs_push_capability")
+        if vs_cap is None or vs_cap < 0.5:
+            _log(
+                f"send-path smoke gate FAILED: "
+                f"wire_vs_push_capability={vs_cap} (must be >= 0.5; "
+                f"the r05 gap was 0.24)"
+            )
+            raise SystemExit(1)
+        # (2) With the full-payload serialization barrier gone, the
+        # coordinator's broadcast-out wall must stay within 1.5× its
+        # contributions-in wall (symmetric bytes; the r05 send/read
+        # session imbalance was 2.7×).
+        wr = extra.get("send_vs_read_wall_ratio")
+        if wr is None or wr > 1.5:
+            _log(
+                f"send-path smoke gate FAILED: "
+                f"send_vs_read_wall_ratio={wr} (must be <= 1.5; was "
+                f"2.7 in r05)"
             )
             raise SystemExit(1)
         return
@@ -2479,11 +2851,18 @@ def main() -> None:
         # once both numbers exist.
         with _section(extra, "push_bench"):
             _log("raw send-proxy push throughput (128MB sharded, loopback)...")
-            push, reshard, packed, perleaf, overlap = _one_child(
-                "_run_push_bench", timeout=600
+            push, reshard, packed, perleaf, overlap, multirail, onerail = (
+                _one_child("_run_push_bench", timeout=900)
             )
             extra["push_GBps"] = round(push, 3)
             extra["push_reshard_GBps"] = round(reshard, 3)
+            # Single 128MB payload striped over 4 rails vs pinned to one
+            # (wire v4 multi-rail fan-out).
+            extra["multirail_GBps"] = round(multirail, 3)
+            extra["singlerail_GBps"] = round(onerail, 3)
+            extra["multirail_vs_single_rail"] = round(
+                multirail / onerail, 3
+            ) if onerail > 0 else None
             # End-to-end compressed-tree exchange (compress → wire →
             # decompress): packed single-buffer codec vs per-leaf.
             extra["cross_party_packed_GBps"] = round(packed, 3)
@@ -2498,7 +2877,10 @@ def main() -> None:
                 f"  push: {push:.3f} GB/s wire, {reshard:.3f} GB/s with "
                 f"re-shard; packed tree {packed:.3f} GB/s vs per-leaf "
                 f"{perleaf:.3f} GB/s ({extra['packed_codec_speedup']}x), "
-                f"send overlap saves {overlap:.0%} of busy time"
+                f"send overlap saves {overlap:.0%} of busy time; "
+                f"multirail {multirail:.3f} GB/s vs single-rail "
+                f"{onerail:.3f} GB/s "
+                f"({extra['multirail_vs_single_rail']}x)"
             )
 
             # Serialized 1-core model for the split step: every byte
@@ -2533,6 +2915,13 @@ def main() -> None:
                     f"{extra['split_fl_vs_ceiling']} of it"
                 )
         _settle()
+
+        with _section(extra, "send_path"):
+            _log("coordinator send-path probe (4-party hub, ResNet-18 "
+                 "bundles, arena + multi-rail)...")
+            sp = _one_child("_run_send_path_bench", ndev=1, timeout=600)
+            _fill_send_path_extra(extra, sp)
+            _settle()
 
         with _section(extra, "stream_agg"):
             _log("streaming FedAvg aggregation (ResNet-18 packed rounds, "
@@ -2599,6 +2988,14 @@ def main() -> None:
                     coord_bytes_per_round / wire_session_s / 1e9, 3
                 )
                 extra["cross_party_wire_GBps"] = extra["cross_party_GBps"]
+            # The r05 verdict's gap decomposition, tracked per round:
+            # the coordinator's summed send sessions over its summed
+            # wire-read sessions (was 2.7×; the send_path section gates
+            # the phase-wall form of this at smoke scale).
+            if coord[2] > 0:
+                extra["resnet_coord_send_vs_read_ratio"] = round(
+                    coord[3] / coord[2], 3
+                )
             # Full decomposition: step wall (jitted local round incl. fused
             # wire casts), per-party CPU, and idle share.  step/wall ≈ 96%
             # on the 1-core host — the rest is transport CPU + idle.

@@ -23,7 +23,14 @@ Public API surface mirrors the reference (``fed/__init__.py:15-29``):
 ``FedObject``.
 """
 
-from rayfed_tpu.api import init, shutdown, remote, get, kill
+from rayfed_tpu.api import (
+    init,
+    shutdown,
+    remote,
+    get,
+    kill,
+    set_max_message_length,
+)
 from rayfed_tpu.exceptions import RemoteError
 from rayfed_tpu.fed_object import FedObject
 from rayfed_tpu.metrics import get_stats
@@ -40,6 +47,7 @@ __all__ = [
     "kill",
     "send",
     "recv",
+    "set_max_message_length",
     "FedObject",
     "RemoteError",
     "tree_util",

@@ -247,6 +247,31 @@ def ping_others(cluster: Dict[str, Dict], self_party: str, max_retries: int = 36
     return True
 
 
+def set_max_message_length(max_bytes: int) -> None:
+    """Mutate the cross-silo message-size cap AFTER ``init`` (parity
+    with adjusting the reference's ``grpc.max_send_message_length`` /
+    ``max_receive_message_length`` channel options, but live).
+
+    Applies atomically to this party's transport server and every live
+    per-peer client, and to clients created later.  Raises
+    ``RuntimeError`` while any cross-party send is mid-flight — the cap
+    change must reject cleanly rather than torn-apply to a payload
+    already on the wire (drain with ``fed.get`` on the pending sends,
+    or retry after the round completes).  Each party controls its own
+    caps; lower both sides when actually shrinking a limit.  Not
+    supported for multi-host parties (``NotImplementedError``): the
+    mutation cannot reach the sibling processes' bridge servers — set
+    ``cross_silo_messages_max_size`` at :func:`init` instead.
+    """
+    runtime = get_runtime()
+    transport = getattr(runtime, "transport", None)
+    if transport is None:
+        raise RuntimeError("transport not started; call fed.init() first")
+    # The manager also updates runtime.job_config (the same object), so
+    # future clients inherit the new cap — one writer, no duplicate here.
+    transport.set_max_message_size(int(max_bytes))
+
+
 def shutdown() -> None:
     """Shutdown this party's runtime (ref ``api.py:231-241``)."""
     runtime = get_runtime_or_none()

@@ -452,6 +452,33 @@ class MultiHostTransport:
             return self._inner.ping(dest_party, timeout_s)
         return True  # non-leaders have no cross-party wire to check
 
+    def set_max_message_size(self, max_bytes: int) -> None:
+        """Runtime message-size cap mutation — NOT supported for
+        multi-host parties: the mutation only reaches this process's
+        objects, while the sibling processes' bridge servers keep the
+        init-time cap — a leader that accepted a newly-allowed large
+        payload would then have its bridge republish fatally rejected
+        by a non-leader, silently desyncing the SPMD program.  Set
+        ``cross_silo_messages_max_size`` at ``fed.init`` instead."""
+        raise NotImplementedError(
+            "set_max_message_length is not supported for a multi-host "
+            "party: the cap change cannot reach the sibling processes' "
+            "bridge servers (they would fatally reject the leader's "
+            "republish of a newly-allowed large payload).  Set "
+            "cross_silo_messages_max_size at fed.init instead."
+        )
+
+    def effective_transport_options(self, dest_party: str) -> Dict[str, Any]:
+        if self._inner is not None:
+            return self._inner.effective_transport_options(dest_party)
+        return {
+            "party": dest_party,
+            "options": {},
+            "ignored_keys": [],
+            "metadata": {},
+            "note": "non-leader process: no cross-party wire",
+        }
+
     def get_stats(self) -> Dict[str, Any]:
         mgr = self._inner if self._inner is not None else self._bridge_mgr
         stats = mgr.get_stats() if mgr is not None else {}

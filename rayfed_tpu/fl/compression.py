@@ -23,10 +23,15 @@ per-leaf **zero-copy views** into the buffer.  Non-float leaves ride
 alongside untouched.  Because ``PackedTree`` is a registered JAX pytree,
 the transport's tensor codec sees exactly one large array leaf — which
 crosses the wire as a single zero-copy buffer (shard-streamed and
-pipelined above :data:`rayfed_tpu.transport.wire.SHARD_STREAM_THRESHOLD`)
-instead of dozens of small ones — and aggregation arithmetic
-(:func:`rayfed_tpu.fl.tree_average`) fuses over the whole model as one
-elementwise op.
+pipelined above :data:`rayfed_tpu.transport.wire.SHARD_STREAM_THRESHOLD`;
+at :data:`~rayfed_tpu.transport.wire.STRIPE_MIN_BYTES` and above its
+4 MB chunks additionally fan out round-robin across the per-destination
+connection pool, with the device→host fetch and CRC of chunk *k+1*
+overlapping the socket write of chunk *k*, and stream sends snapshot
+into a reusable page-aligned send arena instead of allocating per round
+— see ``docs/source/send_path.rst``) instead of dozens of small ones —
+and aggregation arithmetic (:func:`rayfed_tpu.fl.tree_average`) fuses
+over the whole model as one elementwise op.
 
 Both :func:`pack_tree` and :func:`unpack_tree` are traceable: inside a
 ``jit`` (e.g. :func:`rayfed_tpu.models.resnet.make_fed_train_step`) the

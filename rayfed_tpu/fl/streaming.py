@@ -258,6 +258,14 @@ class StreamingAggregator:
 
     def _on_bytes(self, index: int, view: memoryview, total: int) -> None:
         s = self._streams[index]
+        # Arrival contract (both transport paths): ``view`` is the
+        # frame's full payload buffer and ``total`` the CONTIGUOUS
+        # bytes available from offset 0.  Single-socket streams grow
+        # ``total`` as the socket drains; multi-rail stripe frames
+        # (wire v4) feed the growing contiguous VERIFIED-chunk prefix,
+        # so ``total`` may jump by several chunks at once and never
+        # covers unverified or out-of-order bytes — either way the
+        # fold below only ever consumes a true prefix of the payload.
         # ALL state writes happen under the lock — a lockless extent
         # update could race a frame abort's reset and carry a dead
         # frame's byte count onto the retry's fresh buffer.  Only the

@@ -47,6 +47,26 @@ WRITE_CHUNK_BYTES = 4 * 1024 * 1024
 # Mirrors the server's _MAX_DELTA_BASES.
 _MAX_DELTA_STREAMS = 32
 
+# Rails a striped payload fans out over — bounded so a generous
+# connections_per_peer doesn't shred one payload into dozens of tiny
+# interleaved flows (past ~4 rails a single sender saturates either the
+# NIC or the CRC/copy stage anyway).
+MAX_STRIPE_RAILS = 4
+
+
+def _default_stripe_rails() -> int:
+    """Host-adaptive rail count: striping pays only when spare cores
+    run the per-rail CRC/copy stages concurrently with the socket
+    writes.  On a 1-2 core host every rail shares one core AND the
+    receiver pays an extra reassembly memcpy per byte — measured 2×
+    SLOWER than the single-frame path there — so few-core hosts keep
+    one rail (striping off) and the wire-v3 single-frame pipeline.
+    The ``stripe_rails`` transport option overrides this (tests and
+    the multirail bench force it)."""
+    import os
+
+    return max(1, min(MAX_STRIPE_RAILS, (os.cpu_count() or 2) // 2))
+
 
 class SendError(ConnectionError):
     pass
@@ -56,6 +76,14 @@ class FatalSendError(SendError):
     """A send rejected by the peer for a non-transient reason — not retried."""
 
 
+class ProtocolMismatchError(FatalSendError):
+    """The peer speaks a different wire-protocol version.
+
+    Raised from the connection HELLO handshake (wire v4) — naming both
+    versions — instead of letting a mixed-version pair fail later with
+    a confusing manifest-decode error mid-payload."""
+
+
 class DeltaBaseError(SendError):
     """The receiver's delta base is missing/desynced (e.g. it restarted).
 
@@ -63,10 +91,31 @@ class DeltaBaseError(SendError):
     immediately re-sends the full payload, re-seeding both caches."""
 
 
+class _SendArena:
+    """Reusable page-aligned send buffer (anonymous mmap).
+
+    mmap gives page alignment and lazily-faulted memory — the closest
+    portable stand-in for a pinned DMA arena — and reuse across rounds
+    keeps the pages hot instead of paying a fresh multi-MB allocation
+    (plus its page-fault storm) per round, which is exactly the
+    alloc+concat+copy the old snapshot path did."""
+
+    __slots__ = ("mm", "size")
+
+    def __init__(self, size: int) -> None:
+        import mmap
+
+        self.size = max(1, int(size))
+        self.mm = mmap.mmap(-1, self.size)
+
+    def view(self, size: int) -> memoryview:
+        return memoryview(self.mm)[:size]
+
+
 class _DeltaStream:
     """Last-ACKED payload snapshot for one (dest, stream) delta cache."""
 
-    __slots__ = ("data", "ccrc", "fp", "lock")
+    __slots__ = ("data", "ccrc", "fp", "lock", "arenas")
 
     def __init__(self) -> None:
         self.data: Optional[bytes] = None  # full payload the peer holds
@@ -77,6 +126,69 @@ class _DeltaStream:
         # in-flight sends on different pooled connections could arrive
         # reordered.
         self.lock = asyncio.Lock()
+        # Two ping-pong send arenas: the next snapshot is written into
+        # whichever slot the current base (self.data) does NOT alias, so
+        # the base bytes stay stable for delta diffing and for the
+        # receiver's retry semantics.  A failed send leaves the base
+        # slot untouched and the next attempt reuses the other slot.
+        self.arenas: List[Optional[_SendArena]] = [None, None]
+
+    def writable_arena(self, size: int) -> memoryview:
+        """A view over the arena slot not backing the current base."""
+        base_obj = self.data.obj if isinstance(self.data, memoryview) else None
+        for i, arena in enumerate(self.arenas):
+            if arena is not None and arena.mm is base_obj:
+                continue
+            if arena is None or arena.size < size or arena.size > 2 * max(size, 1):
+                arena = _SendArena(size)
+                self.arenas[i] = arena
+            return arena.view(size)
+        # Unreachable (the base aliases at most one slot) — keep a safe
+        # fallback rather than an assert on a hot path.
+        arena = _SendArena(size)
+        self.arenas[0] = arena
+        return arena.view(size)
+
+
+def _iter_chunk_views(payload_bufs: List, csz: int, timings: Dict[str, float]):
+    """Yield ``(nbytes, [views])`` covering the payload in ``csz`` chunks.
+
+    Buffers materialize lazily in walk order — a LazyBuffer's
+    device→host fetch happens when the walk first reaches it, i.e.
+    while earlier chunks are already on a socket — and a chunk spanning
+    buffer boundaries yields multiple views (vectored write, no copy).
+    ``timings["d2h"]`` accumulates the fetch seconds.
+    """
+    cur: List = []
+    cur_n = 0
+    for buf in payload_bufs:
+        t0 = time.perf_counter()
+        host = buf.produce() if isinstance(buf, wire.LazyBuffer) else buf
+        mv = host if isinstance(host, memoryview) else memoryview(host)
+        if mv.format != "B":
+            mv = mv.cast("B")
+        timings["d2h"] += time.perf_counter() - t0
+        off = 0
+        while off < mv.nbytes:
+            take = min(csz - cur_n, mv.nbytes - off)
+            cur.append(mv[off : off + take])
+            cur_n += take
+            off += take
+            if cur_n == csz:
+                yield cur_n, cur
+                cur, cur_n = [], 0
+    if cur_n:
+        yield cur_n, cur
+
+
+def _resolve_ready(fut, item) -> None:
+    if not fut.done():
+        fut.set_result(item)
+
+
+def _fail_ready(fut, exc) -> None:
+    if not fut.done():
+        fut.set_exception(exc)
 
 
 class _Conn:
@@ -119,6 +231,7 @@ class TransportClient:
         checksum: Optional[bool] = None,
         pool_size: int = 2,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        stripe_rails: Optional[int] = None,
     ) -> None:
         if checksum is None:
             # Match the manager's policy: checksum only when the fast C++
@@ -145,9 +258,21 @@ class TransportClient:
         # the coroutine API is loop-agnostic as ever.
         self._loop = loop
         self._rid = itertools.count(1)
+        # Stripe-payload generation ids (wire v4): monotonically
+        # increasing, so the receiver can tell a retry's fresh stripe
+        # group from a stale frame of the failed attempt.
+        self._sid = itertools.count(1)
+        # Version advertised in the connection HELLO handshake —
+        # overridable so tests can exercise the mismatch path.
+        self._proto_version = wire.WIRE_FORMAT_VERSION
         self._conns: List[_Conn] = []
         self._conn_lock = asyncio.Lock()
         self._pool_size = max(1, int(pool_size))
+        # Rails a striped payload fans over: explicit option, else the
+        # host-adaptive default (1 on few-core hosts = striping off).
+        self._stripe_rails_opt = (
+            None if stripe_rails is None else max(1, int(stripe_rails))
+        )
         # Dedicated control connection for health pings: a data
         # connection's write lock is held for a whole frame, so a ping
         # queued on the pool behind a multi-GB push would time out and
@@ -157,6 +282,14 @@ class TransportClient:
         self._ctl_conn: Optional[_Conn] = None
         self._ctl_lock = asyncio.Lock()
         self._closed = False
+        # Whole-operation in-flight send count (loop thread only):
+        # incremented for the FULL span of every send_data call —
+        # including producer fetches before the first frame, retry
+        # backoffs, and connection opens, none of which show up in
+        # per-connection pending/lock state.  The message-cap mutation
+        # guard reads it so a cap change can't slip into one of those
+        # windows and torn-apply to a payload legal when initiated.
+        self._inflight_sends = 0
         # Per-(dest, stream) delta caches — the last payload the peer
         # ACKed on each stream, diffed against the next send so only
         # changed DELTA_CHUNK_BYTES ranges (+ a bitmap manifest) ship.
@@ -182,6 +315,17 @@ class TransportClient:
             "delta_full_frames": 0,
             "delta_logical_bytes": 0,
             "delta_wire_bytes": 0,
+            # Send-path stage breakdown (the gap-can't-silently-reopen
+            # telemetry): device→host fetch, arena/gather copy, CRC,
+            # ready→write loop handoff wait, and raw socket time.
+            "send_d2h_s": 0.0,
+            "send_copy_s": 0.0,
+            "send_crc_s": 0.0,
+            "send_loop_wait_s": 0.0,
+            "send_socket_s": 0.0,
+            # Multi-rail striping accounting.
+            "send_striped_payloads": 0,
+            "send_stripe_frames": 0,
         }
 
     # -- connection management ------------------------------------------------
@@ -204,7 +348,42 @@ class TransportClient:
                     fd = sock.fileno()
         conn = _Conn(reader, writer, fd)
         conn.reader_task = asyncio.ensure_future(self._read_responses(conn))
+        # Version handshake (wire v4): one HELLO round trip before the
+        # connection carries data.  A mixed-version pair fails HERE with
+        # ProtocolMismatchError naming both versions, instead of a
+        # confusing manifest-decode error mid-payload.
+        try:
+            await self._roundtrip(
+                wire.MSG_HELLO,
+                {"src": self._src_party, "ver": self._proto_version},
+                [],
+                timeout_s=min(self._timeout_s, 15.0),
+                conn=conn,
+            )
+        except BaseException:
+            if conn.reader_task is not None:
+                conn.reader_task.cancel()
+                conn.reader_task = None
+            self._teardown(conn, SendError("handshake failed"))
+            raise
         return conn
+
+    async def _acquire_rails(self, k: int) -> List[_Conn]:
+        """``k`` distinct live connections for a striped send (grow the
+        pool as needed; least-busy first)."""
+        async with self._conn_lock:
+            self._conns = [c for c in self._conns if not c.closed]
+            while len(self._conns) < k:
+                self._conns.append(await self._open_conn())
+            return sorted(self._conns, key=lambda c: c.busy)[:k]
+
+    def _stripe_rails(self) -> int:
+        rails = (
+            self._stripe_rails_opt
+            if self._stripe_rails_opt is not None
+            else _default_stripe_rails()
+        )
+        return max(1, min(self._pool_size, MAX_STRIPE_RAILS, rails))
 
     async def _acquire_conn(self) -> _Conn:
         """Pick the least-busy live connection; grow the pool under load."""
@@ -247,7 +426,9 @@ class TransportClient:
                 if fut is None or fut.done():
                     continue
                 if msg_type == wire.MSG_ERR:
-                    if header.get("fatal"):
+                    if header.get("code") == "protocol":
+                        exc_cls = ProtocolMismatchError
+                    elif header.get("fatal"):
                         exc_cls = FatalSendError
                     elif header.get("code") == "delta_base":
                         exc_cls = DeltaBaseError
@@ -464,6 +645,8 @@ class TransportClient:
 
         t_frame0 = time.perf_counter()
         prepare_s = 0.0
+        d2h_s = 0.0
+        crc_s = 0.0
         payload_nbytes = 0
         crc = 0
         head: List = list(frame_bufs)  # rides along with the first chunk
@@ -471,6 +654,7 @@ class TransportClient:
         for i in range(len(payload_bufs)):
             mv, dt = await prefetch
             prepare_s += dt
+            d2h_s += dt
             payload_nbytes += mv.nbytes
             if i + 1 < len(payload_bufs):
                 prefetch = loop.run_in_executor(
@@ -491,6 +675,7 @@ class TransportClient:
                 if crc_trailer:
                     crc, dt = await crc_fut
                     prepare_s += dt
+                    crc_s += dt
                     if j + 1 < len(views):
                         crc_fut = loop.run_in_executor(
                             None, _crc, views[j + 1], crc
@@ -504,13 +689,323 @@ class TransportClient:
         self.stats["send_payload_bytes"] += payload_nbytes
         self.stats["send_prepare_s"] += prepare_s
         self.stats["send_write_s"] += write_s
+        self.stats["send_d2h_s"] += d2h_s
+        self.stats["send_crc_s"] += crc_s
+        self.stats["send_socket_s"] += write_s
         self.stats["send_frame_wall_s"] += time.perf_counter() - t_frame0
 
     @property
     def checksum_enabled(self) -> bool:
         return self._checksum
 
+    def has_inflight_sends(self) -> bool:
+        """True while any :meth:`send_data` call is in progress — from
+        entry (producer fetches, connection opens, retry backoffs)
+        through the final ACK — or any pooled connection has an
+        un-ACKed frame / held write lock (direct ``_roundtrip``
+        callers): the runtime message-size mutation guard (a cap change
+        must reject cleanly rather than torn-apply to a payload on the
+        wire)."""
+        if self._inflight_sends > 0:
+            return True
+        for conn in self._conns:
+            if conn.pending or conn.write_lock.locked():
+                return True
+        return any(st.lock.locked() for st in self._delta_streams.values())
+
+    # -- multi-rail striped sends (wire v4) -----------------------------------
+
+    def _produce_plain_chunks(
+        self, loop, payload_bufs, csz, ready, abort=None
+    ) -> None:
+        """Executor job: cut the payload into ``csz`` chunks as
+        zero-copy views (lazy buffers fetched in walk order) + per-chunk
+        CRC, resolving ``ready[i]`` as chunk ``i`` becomes shippable —
+        chunk k is written to a rail while chunk k+1 is still being
+        fetched from device and CRC'd here.  ``abort`` (threading.Event)
+        stops production between chunks: a failed attempt must not make
+        its retry wait out the full d2h+CRC pass of a dead payload."""
+        import zlib
+
+        timings = {"d2h": 0.0}
+        idx = 0
+        d2h_prev = 0.0
+        try:
+            for _nbytes, views in _iter_chunk_views(payload_bufs, csz, timings):
+                if abort is not None and abort.is_set():
+                    raise SendError("send aborted; chunk production stopped")
+                t0 = time.perf_counter()
+                crc = 0
+                for v in views:
+                    crc = zlib.crc32(v, crc)
+                crc_s = time.perf_counter() - t0
+                d2h_s = timings["d2h"] - d2h_prev
+                d2h_prev = timings["d2h"]
+                item = (
+                    idx, crc, list(views), time.perf_counter(),
+                    d2h_s, 0.0, crc_s,
+                )
+                loop.call_soon_threadsafe(_resolve_ready, ready[idx], item)
+                idx += 1
+        except BaseException as e:  # fail the rails, not the executor
+            for fut in ready[idx:]:
+                loop.call_soon_threadsafe(_fail_ready, fut, e)
+
+    def _produce_arena_chunks(
+        self, loop, payload_bufs, arena_mv, csz,
+        base_mv=None, base_ccrc=None, ready=None, abort=None,
+    ):
+        """Executor job: ONE pass copying the payload into the send
+        arena chunk-by-chunk, CRC'ing each chunk as it lands and — when
+        a delta base is supplied — computing its changed flag in the
+        same pass (the diff aliases both arenas; no re-copy).  With
+        ``ready``, ``ready[i]`` resolves as chunk ``i`` lands, so the
+        fresh-payload striped path ships chunk k while chunk k+1 is
+        still being fetched/copied/CRC'd.
+
+        Returns ``(ccrcs, changed, (d2h_s, copy_s, crc_s))`` —
+        ``changed`` is None without a base; the totals are billed by
+        the caller on the loop thread (the pipelined path bills per
+        chunk through the ready items instead).
+        """
+        import zlib
+
+        import numpy as np
+
+        ccrcs: List[int] = []
+        changed: Optional[List[int]] = [] if base_mv is not None else None
+        timings = {"d2h": 0.0}
+        d2h_prev = copy_total = crc_total = 0.0
+        idx = 0
+        chunk_start = 0
+        try:
+            for nbytes, views in _iter_chunk_views(payload_bufs, csz, timings):
+                if abort is not None and abort.is_set():
+                    raise SendError("send aborted; chunk production stopped")
+                t0 = time.perf_counter()
+                off = chunk_start
+                for v in views:
+                    arena_mv[off : off + v.nbytes] = v
+                    off += v.nbytes
+                copy_s = time.perf_counter() - t0
+                chunk_view = arena_mv[chunk_start : chunk_start + nbytes]
+                t1 = time.perf_counter()
+                crc = zlib.crc32(chunk_view)
+                crc_s = time.perf_counter() - t1
+                ccrcs.append(crc)
+                if changed is not None:
+                    base_chunk = base_mv[chunk_start : chunk_start + nbytes]
+                    if crc != base_ccrc[idx] or not np.array_equal(
+                        np.frombuffer(chunk_view, np.uint8),
+                        np.frombuffer(base_chunk, np.uint8),
+                    ):
+                        changed.append(idx)
+                d2h_s = timings["d2h"] - d2h_prev
+                d2h_prev = timings["d2h"]
+                copy_total += copy_s
+                crc_total += crc_s
+                if ready is not None:
+                    item = (
+                        idx, crc, [chunk_view], time.perf_counter(),
+                        d2h_s, copy_s, crc_s,
+                    )
+                    loop.call_soon_threadsafe(_resolve_ready, ready[idx], item)
+                idx += 1
+                chunk_start += nbytes
+        except BaseException as e:
+            if ready is not None:
+                for fut in ready[idx:]:
+                    loop.call_soon_threadsafe(_fail_ready, fut, e)
+            raise
+        if not ccrcs:  # empty payload: mirror wire.chunk_crcs
+            ccrcs = [zlib.crc32(b"")]
+        return ccrcs, changed, (timings["d2h"], copy_total, crc_total)
+
+    @staticmethod
+    def _ready_chunks(loop, full, ccrcs, indices, csz, total):
+        """Pre-resolved ready futures over an already-snapshotted
+        payload (delta ship / retry of a produced arena)."""
+        now = time.perf_counter()
+        ready = []
+        for i in indices:
+            size = min(csz, total - i * csz)
+            fut = loop.create_future()
+            fut.set_result(
+                (i, ccrcs[i], [full[i * csz : i * csz + size]], now,
+                 0.0, 0.0, 0.0)
+            )
+            ready.append(fut)
+        return ready
+
+    async def _send_striped_frames(
+        self, base_header, total, csz, nch, ready, base_fp=None,
+    ) -> Dict[str, Any]:
+        """Ship one payload as per-chunk stripe frames fanned
+        round-robin across the rails (wire v4).
+
+        Each ready item carries its logical chunk index; ``base_fp``
+        non-None marks the frames as a delta against the receiver's
+        cached base.  On any frame failure every other rail drains
+        before the error surfaces — the payload fails (and retries) as
+        a unit.  Returns the completing frame's ACK header.
+        """
+        nf = len(ready)
+        sid = next(self._sid)
+        rails = await self._acquire_rails(min(self._stripe_rails(), nf))
+
+        async def _one(pos: int, conn: _Conn):
+            idx, crc, views, t_ready, d2h_s, copy_s, crc_s = await ready[pos]
+            st = self.stats
+            st["send_d2h_s"] += d2h_s
+            st["send_copy_s"] += copy_s
+            st["send_crc_s"] += crc_s
+            st["send_prepare_s"] += d2h_s + copy_s + crc_s
+            st["send_loop_wait_s"] += max(0.0, time.perf_counter() - t_ready)
+            hdr = dict(base_header)
+            hdr["ccrc"] = [crc]
+            hdr["dlt"] = wire.make_delta_manifest(
+                total, wire.encode_chunk_bitmap([idx], nch), base_fp
+            )
+            hdr["stp"] = wire.make_stripe_marker(sid, nf)
+            ack = await self._roundtrip(wire.MSG_DATA, hdr, views, conn=conn)
+            st["send_stripe_frames"] += 1
+            return ack
+
+        results = await asyncio.gather(
+            *(_one(pos, rails[pos % len(rails)]) for pos in range(nf)),
+            return_exceptions=True,
+        )
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            for kind in (FatalSendError, DeltaBaseError):
+                for e in errs:
+                    if isinstance(e, kind):
+                        raise e
+            for e in errs:
+                if isinstance(e, asyncio.TimeoutError):
+                    raise e
+            e0 = errs[0]
+            if isinstance(e0, (SendError, OSError, ConnectionError,
+                               asyncio.CancelledError)):
+                raise e0
+            raise SendError(
+                f"striped payload to {self._dest_party} failed: {e0!r}"
+            ) from e0
+        for ack in results:
+            if ack.get("result") == "OK":
+                self.stats["send_striped_payloads"] += 1
+                return ack
+        # Every frame ACKed "SEG" but none completed the assembly: the
+        # receiver lost it mid-group (evicted under memory pressure /
+        # idle-dropped).  This is NOT a delivery — treating it as one
+        # would hang the consumer's rendezvous and (on stream sends)
+        # corrupt the delta-base contract.  Surface as retryable: the
+        # retry re-ships the whole payload under a fresh sid.
+        raise SendError(
+            f"striped payload to {self._dest_party} completed without a "
+            f"delivery ACK (receiver dropped the assembly mid-group); "
+            f"retrying"
+        )
+
+    async def _send_plain_striped(
+        self, header, payload_bufs, payload_len
+    ) -> str:
+        """Non-stream large payload as multi-rail stripe frames.
+
+        Chunks are cut as zero-copy views over the (lazily produced)
+        payload buffers — no arena copy, since nothing diffs against
+        these bytes later — and ship as soon as produced: the single
+        payload that used to ride one socket behind a full-payload
+        encode/CRC barrier now saturates the whole connection pool.
+        """
+        loop = asyncio.get_running_loop()
+        csz = wire.DELTA_CHUNK_BYTES
+        nch = max(1, -(-payload_len // csz))
+        base_header = dict(header)
+        base_header["ccsz"] = csz
+        policy = self._retry_policy
+        backoff: Optional[float] = None
+        last_exc: Optional[Exception] = None
+        for attempt in range(max(1, policy.max_attempts)):
+            if attempt:
+                backoff = policy.next_backoff(backoff)
+                logger.debug(
+                    "[%s] retrying striped send to %s in %.2fs "
+                    "(attempt %d/%d)",
+                    self._src_party, self._dest_party, backoff,
+                    attempt + 1, policy.max_attempts,
+                )
+                await asyncio.sleep(backoff)
+            import threading as _threading
+
+            ready = [loop.create_future() for _ in range(nch)]
+            abort = _threading.Event()
+            producer = loop.run_in_executor(
+                None, self._produce_plain_chunks, loop, payload_bufs, csz,
+                ready, abort,
+            )
+            try:
+                ack = await self._send_striped_frames(
+                    base_header, payload_len, csz, nch, ready
+                )
+                return ack.get("result", "OK")
+            except FatalSendError:
+                raise
+            except asyncio.TimeoutError as e:
+                raise SendError(
+                    f"send to {self._dest_party} timed out after "
+                    f"{self._timeout_s}s"
+                ) from e
+            except (SendError, OSError, ConnectionError) as e:
+                last_exc = e
+                logger.debug(
+                    "[%s] striped send to %s attempt %d/%d failed: %s",
+                    self._src_party, self._dest_party, attempt + 1,
+                    policy.max_attempts, e,
+                )
+            finally:
+                # Stop production at the next chunk boundary: a failed
+                # attempt must not make its retry wait out the rest of
+                # a dead payload's fetch+CRC pass.  (After success the
+                # producer has already finished — the final frame could
+                # not ship without the last chunk.)
+                abort.set()
+                await producer  # never raises: failures land on `ready`
+                for fut in ready:
+                    if fut.done() and not fut.cancelled():
+                        fut.exception()  # mark retrieved
+                    elif not fut.done():
+                        fut.cancel()
+        raise SendError(
+            f"striped send to {self._dest_party} failed after "
+            f"{policy.max_attempts} attempts: {last_exc}"
+        )
+
     async def send_data(
+        self,
+        payload_bufs: List,
+        upstream_seq_id: str,
+        downstream_seq_id: str,
+        metadata: Optional[Dict[str, str]] = None,
+        crc: Optional[int] = None,
+        error: Optional[Dict[str, str]] = None,
+        stream: Optional[str] = None,
+        stream_snapshot: Optional[tuple] = None,
+    ) -> str:
+        """See :meth:`_send_data_impl` — this wrapper only maintains the
+        whole-operation in-flight count :meth:`has_inflight_sends`
+        reads (the message-cap mutation guard)."""
+        self._inflight_sends += 1
+        try:
+            return await self._send_data_impl(
+                payload_bufs, upstream_seq_id, downstream_seq_id,
+                metadata=metadata, crc=crc, error=error, stream=stream,
+                stream_snapshot=stream_snapshot,
+            )
+        finally:
+            self._inflight_sends -= 1
+
+    async def _send_data_impl(
         self,
         payload_bufs: List,
         upstream_seq_id: str,
@@ -560,6 +1055,19 @@ class TransportClient:
         }
         if error is not None:
             header["err"] = error
+        if (
+            error is None
+            and payload_len >= wire.STRIPE_MIN_BYTES
+            and self._stripe_rails() >= 2
+        ):
+            # Multi-rail striping (wire v4): the payload's chunks fan
+            # out round-robin across the connection pool as per-chunk
+            # frames — one large payload no longer rides one socket,
+            # and the fetch/CRC of chunk k+1 overlaps the write of
+            # chunk k with no full-payload serialization barrier.
+            return await self._send_plain_striped(
+                header, payload_bufs, payload_len
+            )
         has_lazy = any(isinstance(b, wire.LazyBuffer) for b in payload_bufs)
         streamed = has_lazy or payload_len >= wire.SHARD_STREAM_THRESHOLD
         crc_trailer = False
@@ -717,13 +1225,25 @@ class TransportClient:
         downstream_seq_id: str, metadata: Optional[Dict[str, str]],
         snapshot: Optional[tuple] = None,
     ) -> str:
-        """Stream send with the per-peer delta cache (wire format v3).
+        """Stream send with the per-peer delta cache (wire v3/v4).
 
-        Ships only the chunks that changed since the last payload the
-        peer ACKed on this stream, plus a bitmap manifest; per-chunk
-        CRCs replace the whole-payload checksum on both ends.  A
-        ``delta_base`` reply (receiver restarted / base desynced) falls
-        back to a full payload, re-seeding both caches."""
+        The payload is snapshotted into the stream's reusable
+        page-aligned send arena (ping-pong slots: the last-ACKed base
+        stays byte-stable in the other slot and the delta diff aliases
+        both — no per-round alloc+concat+copy), per-chunk CRC'd and
+        diffed against the base in the SAME pass, then shipped one of
+        three ways:
+
+        - unchanged / small delta → the single-frame wire-v3 delta path;
+        - large delta (≥ 2 rails) → the changed chunks striped across
+          the rails;
+        - fresh/full payload ≥ :data:`wire.STRIPE_MIN_BYTES` with ≥ 2
+          rails → pipelined stripe frames: chunk k is on a socket while
+          chunk k+1 is still being fetched and CRC'd (no full-payload
+          serialization barrier).
+
+        A ``delta_base`` reply (receiver restarted / base desynced)
+        falls back to a full payload, re-seeding both caches."""
         state = self._delta_streams.setdefault(stream, _DeltaStream())
         self._delta_streams.move_to_end(stream)
         if len(self._delta_streams) > _MAX_DELTA_STREAMS:
@@ -738,23 +1258,17 @@ class TransportClient:
                     del self._delta_streams[key]
         loop = asyncio.get_running_loop()
         async with state.lock:
-            if snapshot is not None:
-                full, ccrcs = snapshot
-            else:
-                full, ccrcs = await loop.run_in_executor(
-                    None, self.snapshot_stream_payload, payload_bufs
-                )
-            if len(full) > self._max_message_size:
+            csz = wire.DELTA_CHUNK_BYTES
+            total = wire.payload_nbytes(payload_bufs)
+            if total > self._max_message_size:
                 raise SendError(
-                    f"message of {len(full)} bytes exceeds configured max "
+                    f"message of {total} bytes exceeds configured max "
                     f"{self._max_message_size}"
                 )
-            fp = wire.crc_fingerprint(ccrcs)
+            nch = max(1, -(-total // csz))
             merged_meta = dict(self._metadata)
             if metadata:
                 merged_meta.update(metadata)
-            csz = wire.DELTA_CHUNK_BYTES
-            nch = len(ccrcs)
             base_header = {
                 "src": self._src_party,
                 "up": str(upstream_seq_id),
@@ -763,38 +1277,128 @@ class TransportClient:
                 "stm": stream,
                 "ccsz": csz,
             }
-            changed: Optional[List[int]] = None
-            if (
+            has_base = (
                 state.data is not None
                 and state.ccrc is not None
-                and len(state.data) == len(full)
-            ):
-                changed = await loop.run_in_executor(
-                    None, self._diff_chunks, full, state.data, ccrcs,
-                    state.ccrc,
+                and len(state.data) == total
+            )
+            # Stripe only with >= 2 rails: on one rail the per-chunk
+            # frames still pay per-frame ACKs and the receiver's
+            # reassembly memcpy with nothing pipelining against them —
+            # the v3 single-frame path (below) already overlaps CRC
+            # with the socket and delivers zero-copy, and it now snaps
+            # into the reusable arena too.
+            stripeable = (
+                total >= wire.STRIPE_MIN_BYTES
+                and nch >= 2
+                and self._stripe_rails() >= 2
+            )
+            full: Optional[memoryview] = None
+            ccrcs: Optional[List[int]] = None
+            changed: Optional[List[int]] = None
+            pipelined = False
+            if snapshot is not None:
+                # Fan-out path: ONE shared snapshot + CRC pass serves
+                # every destination (codec thread); only the diff
+                # against THIS destination's base runs here.
+                full_raw, ccrcs = snapshot
+                full = memoryview(full_raw)
+                if full.format != "B":
+                    full = full.cast("B")
+                if has_base:
+                    changed = await loop.run_in_executor(
+                        None, self._diff_chunks, full, state.data, ccrcs,
+                        state.ccrc,
+                    )
+            elif has_base or not stripeable:
+                # Arena snapshot: copy + CRC + diff in ONE executor
+                # pass over the reused mmap arena.
+                arena_mv = state.writable_arena(total)
+                ccrcs, changed, totals = await loop.run_in_executor(
+                    None, self._produce_arena_chunks, loop, payload_bufs,
+                    arena_mv, csz,
+                    state.data if has_base else None,
+                    state.ccrc if has_base else None,
+                    None,
                 )
-            mv = memoryview(full)
+                full = arena_mv
+                st = self.stats
+                st["send_d2h_s"] += totals[0]
+                st["send_copy_s"] += totals[1]
+                st["send_crc_s"] += totals[2]
+                st["send_prepare_s"] += sum(totals)
+            else:
+                # Fresh stripe-sized payload: production is pipelined
+                # with the stripe frames inside the attempt loop.
+                full = state.writable_arena(total)
+                pipelined = True
+
             # A delta frame only wins when at least one chunk is skipped.
             force_full = changed is None or len(changed) >= nch
             policy = self._retry_policy
             backoff: Optional[float] = None
             last_exc: Optional[Exception] = None
             attempt = 0
+            import threading as _threading
+
             while attempt < max(1, policy.max_attempts):
-                header = dict(base_header)
-                if not force_full:
-                    header["ccrc"] = [ccrcs[i] for i in changed]
-                    header["dlt"] = wire.make_delta_manifest(
-                        len(full),
-                        wire.encode_chunk_bitmap(changed, nch),
-                        state.fp,
-                    )
-                    bufs = [mv[i * csz : (i + 1) * csz] for i in changed]
-                else:
-                    header["ccrc"] = ccrcs
-                    bufs = [mv] if len(full) else []
+                producer = None
+                abort = _threading.Event()
+                ready: Optional[List[asyncio.Future]] = None
                 try:
-                    ack = await self._roundtrip(wire.MSG_DATA, header, bufs)
+                    if force_full and stripeable:
+                        if pipelined and ccrcs is None:
+                            ready = [
+                                loop.create_future() for _ in range(nch)
+                            ]
+                            producer = loop.run_in_executor(
+                                None, self._produce_arena_chunks, loop,
+                                payload_bufs, full, csz, None, None, ready,
+                                abort,
+                            )
+                        else:
+                            ready = self._ready_chunks(
+                                loop, full, ccrcs, list(range(nch)), csz,
+                                total,
+                            )
+                        ack = await self._send_striped_frames(
+                            base_header, total, csz, nch, ready
+                        )
+                    elif (
+                        not force_full
+                        and len(changed) >= 2
+                        and len(changed) * csz >= wire.STRIPE_MIN_BYTES
+                        and self._stripe_rails() >= 2
+                    ):
+                        # Big delta: changed chunks fan out over the
+                        # rails too (same reassembly machinery, with
+                        # the base fingerprint carried per frame).
+                        ready = self._ready_chunks(
+                            loop, full, ccrcs, changed, csz, total
+                        )
+                        ack = await self._send_striped_frames(
+                            base_header, total, csz, nch, ready,
+                            base_fp=state.fp,
+                        )
+                    else:
+                        header = dict(base_header)
+                        if not force_full:
+                            header["ccrc"] = [ccrcs[i] for i in changed]
+                            header["dlt"] = wire.make_delta_manifest(
+                                total,
+                                wire.encode_chunk_bitmap(changed, nch),
+                                state.fp,
+                            )
+                            bufs = [
+                                full[i * csz : (i + 1) * csz]
+                                for i in changed
+                            ]
+                        else:
+                            header["ccrc"] = ccrcs
+                            bufs = [full] if total else []
+                        ack = await self._roundtrip(
+                            wire.MSG_DATA, header, bufs
+                        )
                 except DeltaBaseError:
                     if force_full:  # full sends can't need a base
                         raise
@@ -834,12 +1438,32 @@ class TransportClient:
                     )
                     await asyncio.sleep(backoff)
                     continue
+                finally:
+                    if producer is not None:
+                        # Stop production at the next chunk boundary on
+                        # failure; after success the producer already
+                        # finished (the final frame needed its chunk).
+                        abort.set()
+                        try:
+                            ccrcs, _chg, _totals = await producer
+                        except Exception:
+                            ccrcs = None  # re-produce on the retry
+                        if ready is not None:
+                            for fut in ready:
+                                if fut.done() and not fut.cancelled():
+                                    fut.exception()  # mark retrieved
+                                elif not fut.done():
+                                    fut.cancel()
                 # ACKed: the peer now holds `full` — it IS the new base.
+                wire_bytes = (
+                    total if force_full
+                    else sum(min(csz, total - i * csz) for i in changed)
+                )
                 state.data = full
                 state.ccrc = ccrcs
-                state.fp = fp
-                self.stats["delta_logical_bytes"] += len(full)
-                self.stats["delta_wire_bytes"] += sum(b.nbytes for b in bufs)
+                state.fp = wire.crc_fingerprint(ccrcs)
+                self.stats["delta_logical_bytes"] += total
+                self.stats["delta_wire_bytes"] += wire_bytes
                 if force_full:
                     self.stats["delta_full_frames"] += 1
                 else:

@@ -33,6 +33,23 @@ from rayfed_tpu.transport.server import TransportServer
 logger = logging.getLogger(__name__)
 
 
+# Transport options the client actually consumes; everything else in a
+# party's transport_options/grpc_options is loudly reported as ignored
+# (the reference silently dropped unknown gRPC channel args — an
+# operator typo like "tiemout_s" then just... did nothing).
+_KNOWN_TRANSPORT_OPTIONS = frozenset(
+    {"timeout_s", "max_message_size", "checksum", "connections_per_peer",
+     "stripe_rails"}
+)
+# Reference-style gRPC channel-arg keys accepted for drop-in compat.
+_COMPAT_TRANSPORT_OPTIONS = {
+    "grpc.max_send_message_length": "max_message_size",
+}
+# Recognized-but-inapplicable: there is no gRPC authority to override
+# on a raw socket transport.  Reported with the ignored keys.
+_INAPPLICABLE_TRANSPORT_OPTIONS = frozenset({"grpc.default_authority"})
+
+
 def ring_neighbors(parties: Sequence[str], party: str) -> tuple:
     """``(predecessor, successor)`` of ``party`` on the sorted ring.
 
@@ -89,6 +106,10 @@ class TransportManager:
             "send_op_count": 0,
             "send_bytes": 0,
             "send_seconds": 0.0,
+            # Payload→wire-buffers encode time on the codec pool (the
+            # "encode" stage of the send-path breakdown; the arena copy
+            # is billed client-side as send_copy_s).
+            "send_encode_s": 0.0,
         }
         # Per-destination send wall time (encode handoff → ACK), summed
         # over sends: surfaces which peer a fan-out actually waits on.
@@ -98,6 +119,10 @@ class TransportManager:
         self._dest_lock = threading.Lock()
         self._dest_seconds: Dict[str, float] = {}
         self._dest_ops: Dict[str, int] = {}
+        # Per-destination transport-option keys that were ignored (S3:
+        # never silently dropped) + one-time warning bookkeeping.
+        self._ignored_options: Dict[str, list] = {}
+        self._warned_ignored: set = set()
         # recv_stream bookkeeping: rendezvous key -> src party, so the
         # health monitor can fail chunk-sink waits (which never park in
         # the mailbox) when their source party dies.  Loop thread only.
@@ -316,7 +341,13 @@ class TransportManager:
     # -- client construction --------------------------------------------------
 
     def _merged_options(self, dest_party: str) -> Dict[str, Any]:
-        """Per-destination options, per-party overriding global (ref :250-268)."""
+        """Per-destination options, per-party overriding global (ref :250-268).
+
+        Unknown keys are NOT silently dropped: they are recorded per
+        destination (see :meth:`effective_transport_options`) and a
+        loud one-time warning lists every ignored key — an operator
+        typo must be diagnosable, not a silent no-op.
+        """
         from rayfed_tpu import native
 
         opts: Dict[str, Any] = {
@@ -327,16 +358,112 @@ class TransportManager:
             # per-party {"checksum": True} still forces it.
             "checksum": native.is_available(),
             # Connections per destination: concurrent pushes to one party
-            # ride different sockets (no head-of-line blocking).
+            # ride different sockets (no head-of-line blocking), and a
+            # single striped payload fans its chunks across all of them.
             "connections_per_peer": 2,
         }
         party_opts = dict(self._cluster.party_config(dest_party).transport_options)
         # Accept reference-style gRPC channel-arg keys for drop-in compat.
-        if "grpc.max_send_message_length" in party_opts:
-            opts["max_message_size"] = party_opts.pop("grpc.max_send_message_length")
-        party_opts.pop("grpc.default_authority", None)
-        opts.update(party_opts)
+        for compat_key, real_key in _COMPAT_TRANSPORT_OPTIONS.items():
+            if compat_key in party_opts:
+                opts[real_key] = party_opts.pop(compat_key)
+        unknown = []
+        inapplicable = []
+        for key in list(party_opts):
+            if key in _KNOWN_TRANSPORT_OPTIONS:
+                opts[key] = party_opts.pop(key)
+            else:
+                party_opts.pop(key)
+                if key in _INAPPLICABLE_TRANSPORT_OPTIONS:
+                    inapplicable.append(key)
+                else:
+                    unknown.append(key)
+        unknown.sort()
+        inapplicable.sort()
+        # Everything not applied is reported through the accessor;
+        # recognized-but-inapplicable keys (a reference config's
+        # grpc.default_authority) are named separately in the warning
+        # so they don't read as operator typos.
+        self._ignored_options[dest_party] = unknown + inapplicable
+        if (unknown or inapplicable) and dest_party not in self._warned_ignored:
+            self._warned_ignored.add(dest_party)
+            logger.warning(
+                "[%s] transport options for %s contain keys this "
+                "transport does not use — IGNORED: %s%s (known keys: "
+                "%s; compat aliases: %s)",
+                self._party, dest_party, unknown or "[]",
+                f"; recognized but inapplicable on a raw-socket "
+                f"transport: {inapplicable}" if inapplicable else "",
+                sorted(_KNOWN_TRANSPORT_OPTIONS),
+                sorted(_COMPAT_TRANSPORT_OPTIONS),
+            )
         return opts
+
+    def effective_transport_options(self, dest_party: str) -> Dict[str, Any]:
+        """The merged options a client to ``dest_party`` actually runs
+        with, plus every per-party key that was ignored — the operator
+        debugging accessor for "which knob actually applied".
+
+        Reflects a live client when one exists (post-init mutations
+        like :meth:`set_max_message_size` show through); otherwise the
+        merge that WOULD apply on first contact.
+        """
+        opts = self._merged_options(dest_party)
+        with self._clients_lock:
+            client = self._clients.get(dest_party)
+        if client is not None:
+            opts["timeout_s"] = client._timeout_s
+            opts["max_message_size"] = client._max_message_size
+            opts["checksum"] = client.checksum_enabled
+            opts["connections_per_peer"] = client._pool_size
+            opts["stripe_rails"] = client._stripe_rails()
+        return {
+            "party": dest_party,
+            "options": opts,
+            "ignored_keys": list(self._ignored_options.get(dest_party, [])),
+            "metadata": self.merged_metadata(dest_party),
+        }
+
+    def set_max_message_size(self, max_bytes: int) -> None:
+        """Mutate the cross-silo message-size cap post-init.
+
+        Applies atomically to the server and every live client on the
+        transport loop; future clients inherit it through the job
+        config.  Rejects with a clear error while any send is
+        mid-flight — a torn apply (some frames under the old cap, the
+        ACK under the new) is exactly the confusion this guards
+        against.  Per-party explicit overrides are replaced too: an
+        explicit runtime mutation wins over static config.
+        """
+        max_bytes = int(max_bytes)
+        if max_bytes <= 0:
+            raise ValueError(
+                f"max message length must be positive, got {max_bytes}"
+            )
+
+        async def _apply():
+            with self._clients_lock:
+                clients = dict(self._clients)
+            busy = sorted(
+                p for p, c in clients.items() if c.has_inflight_sends()
+            )
+            if busy:
+                raise RuntimeError(
+                    f"cannot change max message length while sends are "
+                    f"in flight to {busy}; wait for them to drain "
+                    f"(e.g. fed.shutdown's wait_sending, or resolve "
+                    f"the pending send refs) and retry"
+                )
+            for c in clients.values():
+                c._max_message_size = max_bytes
+            self._server._max_message_size = max_bytes
+
+        asyncio.run_coroutine_threadsafe(_apply(), self._loop).result(
+            timeout=30
+        )
+        # Future clients (and _merged_options defaults) follow the job
+        # config — runtime.job_config is this same object.
+        self._job.cross_silo_messages_max_size = max_bytes
 
     def merged_metadata(self, dest_party: str) -> Dict[str, str]:
         meta = dict(self._job.metadata)
@@ -361,6 +488,9 @@ class TransportManager:
                     checksum=bool(opts.get("checksum", True)),
                     pool_size=int(opts.get("connections_per_peer", 2)),
                     loop=self._loop,
+                    # Rails a striped payload fans over; None = host-
+                    # adaptive (striping off on few-core hosts).
+                    stripe_rails=opts.get("stripe_rails"),
                 )
                 self._clients[dest_party] = client
             return client
@@ -497,6 +627,7 @@ class TransportManager:
 
         def _encode_and_send(value: Any) -> None:
             try:
+                t_enc0 = time.perf_counter()
                 bufs = wire.encode_payload(value, lazy_shards=True)
                 if len(dests) > 1:
                     bufs = wire.share_buffers(bufs)
@@ -505,13 +636,18 @@ class TransportManager:
                     isinstance(b, wire.LazyBuffer) for b in bufs
                 ) or nbytes >= wire.SHARD_STREAM_THRESHOLD
                 snapshot = None
-                if stream is not None:
+                if stream is not None and len(dests) > 1:
                     # ONE contiguous snapshot + chunk-CRC pass (codec
                     # thread), shared by every destination's delta
                     # cache — the fan-out contract of this method.
+                    # Single-destination stream sends skip it: the
+                    # client snapshots into its reusable per-(dest,
+                    # stream) send arena instead (zero per-round
+                    # allocation, pipelined with the stripe frames).
                     snapshot = TransportClient.snapshot_stream_payload(
                         bufs
                     )
+                self.stats["send_encode_s"] += time.perf_counter() - t_enc0
                 crc = None
                 if stream is None and not streaming and self._get_client(
                     dests[0]
@@ -798,8 +934,26 @@ class TransportManager:
             "send_write_s", "send_frame_wall_s",
             "delta_stream_frames", "delta_full_frames",
             "delta_logical_bytes", "delta_wire_bytes",
+            "send_d2h_s", "send_copy_s", "send_crc_s",
+            "send_loop_wait_s", "send_socket_s",
+            "send_striped_payloads", "send_stripe_frames",
         ):
             stats[key] = sum(c.stats[key] for c in clients)
+        # Send-path stage breakdown (ISSUE 5's can't-silently-reopen
+        # telemetry): where every second between "payload ready" and
+        # "bytes on the wire" went.  encode = pytree→wire buffers
+        # (codec pool) + arena/gather copies; d2h = device→host
+        # fetches; crc = all checksum passes; loop_wait = produced
+        # chunks waiting for a rail/loop slot; socket = writev/drain.
+        stats["send_path_breakdown_ms"] = {
+            "encode_ms": round(
+                (stats["send_encode_s"] + stats["send_copy_s"]) * 1e3, 2
+            ),
+            "d2h_ms": round(stats["send_d2h_s"] * 1e3, 2),
+            "crc_ms": round(stats["send_crc_s"] * 1e3, 2),
+            "loop_wait_ms": round(stats["send_loop_wait_s"] * 1e3, 2),
+            "socket_ms": round(stats["send_socket_s"] * 1e3, 2),
+        }
         # Fraction of stream-send logical bytes the delta cache kept off
         # the wire (0.0 when no stream sends happened).
         logical = stats["delta_logical_bytes"]

@@ -48,10 +48,64 @@ _MAX_HEADER_BYTES = 1 * 1024 * 1024
 # Delta bases retained per server: one full payload per (src, stream) —
 # bounded LRU so a peer cycling stream names can't grow memory unbounded.
 _MAX_DELTA_BASES = 32
+# In-progress multi-rail stripe reassemblies retained (wire v4): one
+# payload-sized buffer each, keyed by rendezvous — bounded LRU plus an
+# idle-drop so an abandoned sender can't pin payload buffers forever.
+_MAX_STRIPE_ASM = 8
+_STRIPE_IDLE_DROP_S = 600.0
 
 
 class _DeltaBaseMissing(Exception):
     """The delta's base payload isn't cached here (restart/desync)."""
+
+
+class _StripeFatal(Exception):
+    """A striped payload rejected for a non-transient reason (e.g. it
+    exceeds this server's message-size cap): replied ``fatal`` so the
+    sender aborts instead of fruitlessly re-shipping gigabytes — parity
+    with the single-frame path's ``_fatal_oversize``."""
+
+
+class _StripeReject(ValueError):
+    """A stripe frame rejected for protocol-STATE reasons — stale sid,
+    evicted assembly, geometry disagreement — not data corruption.
+    Counted as ``receive_stripe_rejects`` so an eviction burst doesn't
+    read as phantom CRC errors in the stats."""
+
+
+class _StripeAsm:
+    """One in-progress multi-rail payload reassembly (wire v4).
+
+    Frames of the same payload land concurrently on different rail
+    connections; chunk placement is serialized by the per-assembly
+    lock, the map itself by the server's stripe lock.  ``prefix``
+    tracks the contiguous VERIFIED chunk prefix — the only bytes a
+    chunk sink ever sees, which is what lets a streaming aggregator
+    keep folding under shuffled cross-rail arrival.
+    """
+
+    __slots__ = (
+        "sid", "total", "csz", "nch", "nf", "buf", "ccrc", "have",
+        "frames", "is_delta", "prefix", "shipped", "read_s", "lock",
+        "touched",
+    )
+
+    def __init__(self, sid, total, csz, nch, nf, buf, ccrc, is_delta):
+        self.sid = sid
+        self.total = total
+        self.csz = csz
+        self.nch = nch
+        self.nf = nf
+        self.buf = buf
+        self.ccrc = ccrc
+        self.have: set = set()
+        self.frames = 0
+        self.is_delta = is_delta
+        self.prefix = 0   # contiguous verified chunks from index 0
+        self.shipped = 0  # wire bytes received for this assembly
+        self.read_s = 0.0
+        self.lock = threading.Lock()
+        self.touched = time.monotonic()
 
 
 class _FrameProtocol(asyncio.BufferedProtocol):
@@ -370,6 +424,45 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             header = dict(header, crc=trailer_crc)
         self._reset()
 
+        if msg_type == wire.MSG_HELLO:
+            # Connection handshake (wire v4): a mixed-version pair must
+            # fail HERE with a message naming both versions, not later
+            # with a confusing manifest-decode error mid-payload.
+            peer_ver = int(header.get("ver", 1))
+            if peer_ver != wire.WIRE_FORMAT_VERSION:
+                logger.warning(
+                    "[%s] rejecting connection from %s: peer speaks wire "
+                    "protocol v%s, this party speaks v%s",
+                    server._party, header.get("src", self._peer),
+                    peer_ver, wire.WIRE_FORMAT_VERSION,
+                )
+                self._reply(
+                    wire.MSG_ERR,
+                    {
+                        "rid": header.get("rid"),
+                        "fatal": True,
+                        "code": "protocol",
+                        "error": (
+                            f"wire protocol version mismatch: peer "
+                            f"{header.get('src', '?')!r} speaks "
+                            f"v{peer_ver}, party {server._party!r} "
+                            f"speaks v{wire.WIRE_FORMAT_VERSION} — "
+                            f"upgrade the older party"
+                        ),
+                    },
+                )
+                # Flush the reply, then drop the connection.
+                asyncio.get_running_loop().call_soon(self._abort)
+                return
+            self._reply(
+                wire.MSG_HELLO,
+                {
+                    "rid": header.get("rid"),
+                    "ver": wire.WIRE_FORMAT_VERSION,
+                    "src": server._party,
+                },
+            )
+            return
         if msg_type == wire.MSG_PING:
             self._reply(wire.MSG_PONG, {"rid": header.get("rid")})
             return
@@ -443,6 +536,54 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         deltas), so large frames run them off-loop with reading paused —
         same discipline as the whole-payload CRC offload."""
         server = self._server
+        if header.get("stp") is not None:
+            # Multi-rail stripe frame (wire v4): verify + place this
+            # frame's chunks into the payload's reassembly buffer.
+            # Other rails' frames keep flowing on their own
+            # connections while this one verifies off-loop.  Keyed on
+            # the LOGICAL total, not this frame's size: the group's
+            # first frame allocates the whole assembly buffer (and for
+            # deltas copies the cached base), and a short tail chunk
+            # arriving first must not run that multi-GB byte work on
+            # the event loop (same rule as the wire-v3 branch below).
+            transport = self._transport
+            _dlt = header.get("dlt") or {}
+            big = max(
+                len(payload), int(_dlt.get("total") or 0)
+            ) >= _OFFLOAD_CRC_BYTES
+            if big and transport is not None:
+                transport.pause_reading()
+            loop = asyncio.get_running_loop()
+            if big:
+                fut = loop.run_in_executor(
+                    None, _apply_stripe_frame, server, header, payload,
+                    read_seconds,
+                )
+
+                def _done(f):
+                    try:
+                        final, read_total = f.result()
+                        exc = None
+                    except Exception as e:
+                        final, read_total, exc = None, read_seconds, e
+                    finally:
+                        if transport is not None and not self._closed:
+                            transport.resume_reading()
+                    self._stripe_result(header, read_total, final, exc)
+
+                fut.add_done_callback(
+                    lambda f: loop.call_soon_threadsafe(_done, f)
+                )
+                return
+            try:
+                final, read_total = _apply_stripe_frame(
+                    server, header, payload, read_seconds
+                )
+                exc = None
+            except Exception as e:
+                final, read_total, exc = None, read_seconds, e
+            self._stripe_result(header, read_total, final, exc)
+            return
         dlt = header.get("dlt")
         total = int(dlt["total"]) if dlt else len(payload)
         if total >= _OFFLOAD_CRC_BYTES:
@@ -490,6 +631,76 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 logger.exception(
                     "[%s] chunk sink abort failed", self._server._party
                 )
+
+    def _stripe_result(self, header, read_seconds, final, exc) -> None:
+        """Reply for one stripe frame: SEG while the payload assembles,
+        the ordinary delivery path on completion, errors as MSG_ERR."""
+        server = self._server
+        if exc is not None:
+            if isinstance(exc, _DeltaBaseMissing):
+                server.stats["receive_delta_base_misses"] = (
+                    server.stats.get("receive_delta_base_misses", 0) + 1
+                )
+                self._reply(
+                    wire.MSG_ERR,
+                    {
+                        "rid": header.get("rid"),
+                        "code": "delta_base",
+                        "error": str(exc),
+                    },
+                )
+                return
+            if isinstance(exc, _StripeFatal):
+                # Non-transient (oversize): abort the send instead of
+                # letting the retry policy re-ship the whole payload.
+                self._notify_sink_abort(header, corrupt=False)
+                self._reply(
+                    wire.MSG_ERR,
+                    {
+                        "rid": header.get("rid"),
+                        "fatal": True,
+                        "error": str(exc),
+                    },
+                )
+                return
+            if isinstance(exc, _StripeReject):
+                # Protocol-state reject (stale sid / evicted assembly /
+                # geometry): NOT corruption — its own counter, so an
+                # eviction burst can't read as phantom CRC errors.
+                server.stats["receive_stripe_rejects"] = (
+                    server.stats.get("receive_stripe_rejects", 0) + 1
+                )
+                self._notify_sink_abort(header, corrupt=False)
+                self._reply(
+                    wire.MSG_ERR,
+                    {
+                        "rid": header.get("rid"),
+                        "error": f"stripe frame rejected: {exc}",
+                    },
+                )
+                return
+            server.stats["receive_crc_errors"] = (
+                server.stats.get("receive_crc_errors", 0) + 1
+            )
+            # Clean abort, never corrupt: a sink only ever saw VERIFIED
+            # prefix bytes (identical on the sender's full retry), so
+            # its folded blocks stay a valid prefix — reset-and-retry,
+            # not the unrecoverable donated-accumulator failure.
+            self._notify_sink_abort(header, corrupt=False)
+            self._reply(
+                wire.MSG_ERR,
+                {
+                    "rid": header.get("rid"),
+                    "error": f"stripe frame verification failed: {exc}",
+                },
+            )
+            return
+        if final is None:
+            self._reply(
+                wire.MSG_ACK, {"rid": header.get("rid"), "result": "SEG"}
+            )
+            return
+        self._finish_data(header, final, read_seconds, None, None)
 
     def _stream_result(self, header, read_seconds, final, exc) -> None:
         server = self._server
@@ -678,6 +889,191 @@ def _verify_and_apply_stream(server: "TransportServer", header, payload):
     return new
 
 
+def _apply_stripe_frame(
+    server: "TransportServer", header, payload, read_seconds
+):
+    """Verify and place one stripe frame's chunks (wire v4).
+
+    Returns ``(full_payload, read_s_total)`` when the frame completes
+    its payload's reassembly, ``(None, read_seconds)`` while partial.
+    Executor-thread safe: frames of one payload arrive concurrently on
+    different rail connections — the assembly map is guarded by the
+    server's stripe lock, chunk placement by the per-assembly lock.
+
+    A frame whose ``sid`` is newer than the pending assembly's replaces
+    it (the sender's retry re-ships the whole payload under a fresh
+    sid); an older ``sid`` is a stale frame of a failed attempt and is
+    rejected.  Fresh payloads additionally feed any registered chunk
+    sink their growing contiguous VERIFIED prefix, so streaming
+    aggregation keeps overlapping the wire under shuffled arrival.
+    """
+    import zlib
+
+    stp = header["stp"]
+    dlt = header["dlt"]
+    src = header.get("src", "?")
+    stm = header.get("stm")
+    sid = int(stp["sid"])
+    nf = int(stp["nf"])
+    total = int(dlt["total"])
+    csz = int(header.get("ccsz") or wire.DELTA_CHUNK_BYTES)
+    nch = max(1, -(-total // csz))
+    key = (src, str(header.get("up")), str(header.get("down")))
+    is_delta = "bfp" in dlt
+
+    with server._stripe_lock:
+        now = time.monotonic()
+        for k in list(server._stripes):  # drop abandoned assemblies
+            if now - server._stripes[k].touched > _STRIPE_IDLE_DROP_S:
+                server._note_stripe_evicted(k, server._stripes[k].sid)
+                del server._stripes[k]
+        asm = server._stripes.get(key)
+        if asm is not None and sid < asm.sid:
+            raise _StripeReject(
+                f"stale stripe frame (sid {sid} < current {asm.sid})"
+            )
+        if asm is None and (key, sid) in server._stripe_evicted:
+            # A continuation frame of a group whose assembly was
+            # evicted: recreating it would restart the frame counter
+            # and the group could never complete (every rail would ACK
+            # SEG forever).  Fail the frame so the sender drains its
+            # rails and re-ships the payload under a fresh sid.
+            raise _StripeReject(
+                f"stripe assembly (sid {sid}) was dropped under memory "
+                f"pressure before this frame arrived; re-send the payload"
+            )
+        if asm is None or sid > asm.sid:
+            if total > server._max_message_size:
+                raise _StripeFatal(
+                    f"striped message of {total} bytes exceeds max "
+                    f"{server._max_message_size}"
+                )
+            if is_delta:
+                if stm is None:
+                    raise ValueError("delta stripe frame without a stream")
+                base = server._get_delta_base(src, stm)
+                if base is None:
+                    raise _DeltaBaseMissing(
+                        f"no cached base for stream {stm!r} from {src!r}"
+                    )
+                if len(base["data"]) != total or base["fp"] != int(dlt["bfp"]):
+                    raise _DeltaBaseMissing(
+                        f"cached base for stream {stm!r} from {src!r} "
+                        f"desynced (restart or lost update)"
+                    )
+                buf = bytearray(base["data"])
+                ccrc = list(base["ccrc"])
+            else:
+                buf = bytearray(total)
+                ccrc = [0] * nch
+            asm = _StripeAsm(sid, total, csz, nch, nf, buf, ccrc, is_delta)
+            server._stripes[key] = asm
+            server._stripes.move_to_end(key)
+            while len(server._stripes) > _MAX_STRIPE_ASM:
+                old_key, old_asm = server._stripes.popitem(last=False)
+                # The evicted group can never complete now — remember
+                # it so its remaining frames error (sender retries)
+                # instead of silently recreating a counter that never
+                # reaches nf.
+                server._note_stripe_evicted(old_key, old_asm.sid)
+        else:
+            server._stripes.move_to_end(key)
+        asm.touched = now
+
+    try:
+        if (
+            asm.total != total or asm.csz != csz or asm.nf != nf
+            or asm.is_delta != is_delta
+        ):
+            raise _StripeReject("stripe frames disagree on payload geometry")
+        indices = wire.decode_chunk_bitmap(dlt["map"], nch)
+        ccrc_hdr = header["ccrc"]
+        if len(indices) != len(ccrc_hdr):
+            raise ValueError(
+                f"stripe bitmap selects {len(indices)} chunks but "
+                f"{len(ccrc_hdr)} CRCs were sent"
+            )
+        mv = memoryview(payload)
+        with asm.lock:
+            off = 0
+            for i, expect in zip(indices, ccrc_hdr):
+                size = min(csz, total - i * csz)
+                chunk = mv[off : off + size]
+                if len(chunk) != size:
+                    raise ValueError("stripe payload shorter than its bitmap")
+                if zlib.crc32(chunk) != expect:
+                    raise ValueError(f"stripe chunk {i} CRC mismatch")
+                asm.buf[i * csz : i * csz + size] = chunk
+                asm.ccrc[i] = expect
+                asm.have.add(i)
+                off += size
+            if off != len(mv):
+                raise ValueError(
+                    f"stripe payload has {len(mv) - off} trailing bytes"
+                )
+            asm.frames += 1
+            asm.shipped += len(mv)
+            asm.read_s += read_seconds
+            complete = asm.frames >= asm.nf
+            if complete and not asm.is_delta and len(asm.have) != nch:
+                raise ValueError(
+                    f"stripe group complete with {len(asm.have)}/{nch} chunks"
+                )
+            feed_to = 0
+            if not asm.is_delta:
+                while asm.prefix in asm.have:
+                    asm.prefix += 1
+                feed_to = min(asm.prefix * csz, total)
+    except Exception:
+        # A bad frame kills the whole assembly: the sender fails the
+        # payload as a unit and re-ships it under a fresh sid.  Mark it
+        # evicted so sibling frames still in flight on other rails fail
+        # fast instead of recreating a counter that can't complete.
+        with server._stripe_lock:
+            if server._stripes.get(key) is asm:
+                server._note_stripe_evicted(key, asm.sid)
+                del server._stripes[key]
+        raise
+
+    if not complete:
+        if feed_to:
+            sink = server.peek_chunk_sink(
+                (str(header.get("up")), str(header.get("down")))
+            )
+            if sink is not None:
+                try:  # sinks are thread-safe (see fl.streaming)
+                    sink.on_bytes(memoryview(asm.buf), feed_to)
+                except Exception:
+                    logger.exception(
+                        "[%s] chunk sink failed (stripe feed)",
+                        server._party,
+                    )
+        return None, read_seconds
+
+    with server._stripe_lock:
+        if server._stripes.get(key) is asm:
+            del server._stripes[key]
+    if stm is not None:
+        server._store_delta_base(
+            src, stm, asm.buf, asm.ccrc, wire.crc_fingerprint(asm.ccrc)
+        )
+    server.stats["receive_stripe_frames"] = (
+        server.stats.get("receive_stripe_frames", 0) + asm.frames
+    )
+    server.stats["receive_striped_payloads"] = (
+        server.stats.get("receive_striped_payloads", 0) + 1
+    )
+    if asm.is_delta:
+        server.stats["receive_delta_frames"] = (
+            server.stats.get("receive_delta_frames", 0) + 1
+        )
+        server.stats["receive_delta_bytes_saved"] = (
+            server.stats.get("receive_delta_bytes_saved", 0)
+            + total - asm.shipped
+        )
+    return asm.buf, asm.read_s
+
+
 class TransportServer:
     def __init__(
         self,
@@ -713,12 +1109,35 @@ class TransportServer:
         self._delta_bases: "collections.OrderedDict[Tuple[str, str], Dict]" = (
             collections.OrderedDict()
         )
+        # Multi-rail stripe reassemblies (wire v4): rendezvous key →
+        # in-progress _StripeAsm.  Touched from several executor
+        # threads concurrently (one per rail connection) — the map is
+        # guarded here, chunk placement by each assembly's own lock.
+        self._stripe_lock = threading.Lock()
+        self._stripes: "collections.OrderedDict[Tuple[str, str, str], _StripeAsm]" = (
+            collections.OrderedDict()
+        )
+        # (key, sid) pairs whose in-progress assembly was evicted (LRU
+        # pressure / idle drop): their continuation frames must error —
+        # recreating the assembly would restart the frame counter and
+        # the group could never complete.  Bounded ring; guarded by
+        # _stripe_lock.
+        self._stripe_evicted: "collections.OrderedDict[Tuple, None]" = (
+            collections.OrderedDict()
+        )
         # Chunk sinks: (up, down) → streaming consumer (loop thread
         # only; registered by TransportManager.recv_stream).
         self._chunk_sinks: Dict[Tuple[str, str], Any] = {}
         # Live connections (loop thread only): stop() aborts them so
         # peers see EOF promptly instead of half-open sockets.
         self._protocols: set = set()
+
+    def _note_stripe_evicted(self, key, sid: int) -> None:
+        """Record an evicted in-progress stripe group (caller holds
+        ``_stripe_lock``)."""
+        self._stripe_evicted[(key, sid)] = None
+        while len(self._stripe_evicted) > 4 * _MAX_STRIPE_ASM:
+            self._stripe_evicted.popitem(last=False)
 
     def note_rx_progress(self, party: Optional[str], nbytes: int) -> None:
         if party:

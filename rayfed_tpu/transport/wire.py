@@ -50,18 +50,31 @@ HEADER_SIZE = _HEADER_STRUCT.size
 # different framing of the skeleton) — ``tool/check_wire_format.py``
 # (run by test.sh) fails the build when the layout fingerprint drifts
 # without a version bump.  Receivers reject payloads from a NEWER
-# format than they understand instead of misparsing them.
+# format than they understand instead of misparsing them, and — since
+# v4 — every connection opens with a HELLO handshake carrying this
+# version, so two parties on different builds fail with a clean
+# ProtocolMismatchError naming both versions instead of a confusing
+# manifest-decode error mid-payload.
 # History: 1 = unversioned original; 2 = "v" field added to manifest;
 # 3 = stream/delta frames ("stm"/"ccsz"/"ccrc"/"dlt" header fields:
 # per-chunk CRCs + changed-chunk bitmap manifest for per-peer delta
-# sends — see make_delta_manifest).
-WIRE_FORMAT_VERSION = 3
+# sends — see make_delta_manifest); 4 = connection HELLO handshake
+# (MSG_HELLO + "ver"), multi-rail stripe frames ("stp" marker, "dlt"
+# with optional "bfp": a large payload's chunks fan out round-robin
+# across the per-destination connection pool as per-chunk frames and
+# are reassembled by (stream, chunk index) on the receiver).
+WIRE_FORMAT_VERSION = 4
 
 MSG_DATA = 1
 MSG_ACK = 2
 MSG_PING = 3
 MSG_PONG = 4
 MSG_ERR = 5
+# Connection handshake (v4): the first frame a client sends on every
+# new connection, header {"ver": WIRE_FORMAT_VERSION, "src": party}.
+# The server replies MSG_HELLO {"ver": ...} on match, or a fatal
+# MSG_ERR code="protocol" naming both versions on mismatch.
+MSG_HELLO = 6
 
 # Frame flag: a 4-byte CRC32-C trailer follows the payload (streamed
 # sends compute the checksum incrementally, so it can't ride the header).
@@ -85,6 +98,18 @@ ND_ZERO_COPY_MIN_BYTES = 1 * 1024 * 1024
 # cover exactly these ranges.  Matches the client's WRITE_CHUNK_BYTES so
 # a shipped chunk is one writev unit.
 DELTA_CHUNK_BYTES = 4 * 1024 * 1024
+
+# Payloads at or above this size ship as per-chunk stripe frames (wire
+# v4) fanned round-robin across the per-destination connection pool:
+# chunk k is on a socket while chunk k+1 is still being fetched from
+# device and CRC'd — no full-payload serialization barrier — and the
+# receiver reassembles by (stream, chunk index) with the delta-bitmap
+# machinery.  Below it — or when fewer than 2 rails are available
+# (client._default_stripe_rails: striping needs spare cores to pay for
+# the per-frame ACKs and the receiver's reassembly memcpy) — the
+# single-frame paths (cheaper per-payload header/ACK overhead, zero-
+# copy delivery) are kept.
+STRIPE_MIN_BYTES = 8 * 1024 * 1024
 
 # Metadata key stamping a DATA frame with the federated round it belongs
 # to (pipelined rounds keep one round's aggregation in flight under the
@@ -695,7 +720,30 @@ def decode_chunk_bitmap(hexmap: str, nchunks: int) -> List[int]:
     return [i for i in range(nchunks) if bits[i >> 3] & (1 << (i & 7))]
 
 
-def make_delta_manifest(total: int, bitmap_hex: str, base_fp: int) -> Dict[str, Any]:
+def make_delta_manifest(
+    total: int, bitmap_hex: str, base_fp: Optional[int] = None
+) -> Dict[str, Any]:
     """The ``dlt`` header field — the single producer of its schema
-    (``tool/check_wire_format.py`` fingerprints it)."""
-    return {"total": int(total), "map": bitmap_hex, "bfp": int(base_fp)}
+    (``tool/check_wire_format.py`` fingerprints it).
+
+    ``base_fp=None`` (v4 stripe frames only) omits ``bfp``: the frame's
+    chunks are a segment of a FRESH payload to assemble, not a delta
+    against a cached base.  Ordinary delta frames always carry ``bfp``.
+    """
+    d: Dict[str, Any] = {"total": int(total), "map": bitmap_hex}
+    if base_fp is not None:
+        d["bfp"] = int(base_fp)
+    return d
+
+
+def make_stripe_marker(sid: int, nf: int) -> Dict[str, int]:
+    """The ``stp`` header field of a multi-rail stripe frame (wire v4).
+
+    ``sid`` — payload generation id, monotonically increasing per
+    client: a retry re-ships the whole payload under a fresh sid and
+    the receiver discards any stale partial assembly for the same
+    rendezvous.  ``nf`` — total frames in this payload's stripe group;
+    assembly completes when all ``nf`` frames verified.  Single
+    producer of the schema (fingerprinted by tool/check_wire_format).
+    """
+    return {"sid": int(sid), "nf": int(nf)}

@@ -22,14 +22,19 @@ JAX_PLATFORMS=cpu python tool/check_wire_format.py
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 
 # Fast bench smoke: drives the streaming-aggregation + delta-cache
-# pipeline, the 4-party ring reduce-scatter round AND the pipelined
-# (overlap=True) round engine end-to-end over real sockets (small
-# bundles) so a transport/aggregation regression fails CI, not the
-# next bench round.  Gates: coord_bytes_in_frac <= 0.4 (the ring must
-# keep the coordinator's share of cluster ingress at ~1/N; the hub
-# pins it at ~0.5) and overlap_hidden_comm_frac >= 0.5 (the pipelined
-# engine must hide at least half the per-round comms wall under local
-# compute).
+# pipeline, the 4-party ring reduce-scatter round, the pipelined
+# (overlap=True) round engine AND the arena/multi-rail coordinator
+# send path end-to-end over real sockets (small bundles) so a
+# transport/aggregation regression fails CI, not the next bench round.
+# Gates: coord_bytes_in_frac <= 0.4 (the ring must keep the
+# coordinator's share of cluster ingress at ~1/N; the hub pins it at
+# ~0.5), overlap_hidden_comm_frac >= 0.5 (the pipelined engine must
+# hide at least half the per-round comms wall under local compute),
+# wire_vs_push_capability >= 0.5 (the FedAvg exchange must sustain at
+# least half the same-box push capability — the r05 send-path gap was
+# 0.24) and send_vs_read_wall_ratio <= 1.5 (no full-payload
+# serialization barrier in front of the coordinator's broadcast; the
+# r05 send/read imbalance was 2.7x).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

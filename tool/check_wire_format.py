@@ -102,6 +102,17 @@ def compute_fingerprint() -> str:
         bitmap_hex=wire.encode_chunk_bitmap([0, 2], 4),
         base_fp=wire.crc_fingerprint([1, 2, 3]),
     )
+    # Wire v4: stripe frames carry a bfp-less delta manifest (a fresh
+    # payload's segment, not a delta) — both shapes are contract.
+    stripe_delta_manifest = wire.make_delta_manifest(
+        total=3 * wire.DELTA_CHUNK_BYTES + 16,
+        bitmap_hex=wire.encode_chunk_bitmap([1], 4),
+    )
+    stripe_marker = wire.make_stripe_marker(sid=7, nf=4)
+    # Connection HELLO handshake (wire v4): the first frame on every
+    # connection; both sides parse these header keys, and the version
+    # value is what a ProtocolMismatchError names.
+    hello_header_keys = ["ver", "src"]
 
     # Ring stripe manifest (the "rsm" sideband leaf of ring stripe
     # payloads, rayfed_tpu.fl.ring): a cross-party contract layered on
@@ -123,11 +134,15 @@ def compute_fingerprint() -> str:
             "frame_struct": wire._HEADER_STRUCT.format,
             "magic": wire.MAGIC.decode(),
             "msg_types": [wire.MSG_DATA, wire.MSG_ACK, wire.MSG_PING,
-                          wire.MSG_PONG, wire.MSG_ERR],
+                          wire.MSG_PONG, wire.MSG_ERR, wire.MSG_HELLO],
             "flags": [wire.FLAG_CRC_TRAILER],
             "delta_manifest_schema": _schema(delta_manifest),
-            "stream_header_keys": ["stm", "ccsz", "ccrc", "dlt"],
+            "stripe_delta_manifest_schema": _schema(stripe_delta_manifest),
+            "stripe_marker_schema": _schema(stripe_marker),
+            "stream_header_keys": ["stm", "ccsz", "ccrc", "dlt", "stp"],
+            "hello_header_keys": hello_header_keys,
             "delta_chunk_bytes": wire.DELTA_CHUNK_BYTES,
+            "stripe_min_bytes": wire.STRIPE_MIN_BYTES,
             # Round tagging (pipelined rounds): the metadata key naming
             # the federated round a frame belongs to.  Rides the
             # ordinary "meta" dict — no frame-layout change, but the key
