@@ -1080,6 +1080,174 @@ def _fill_ring_extra(extra: dict, res: dict) -> None:
     )
 
 
+CHAOSB_PARTIES = ("alice", "bob", "carol", "dave")
+CHAOSB_CLUSTER = {
+    p: {"address": f"127.0.0.1:{13170 + i}"}
+    for i, p in enumerate(CHAOSB_PARTIES)
+}
+# Fast death detection ONLY for the party the schedule crashes (the
+# per-party health knobs); a loaded-but-healthy coordinator must never
+# be falsely declared dead by aggressive global knobs.
+CHAOSB_CLUSTER["dave"]["transport_options"] = {
+    "heartbeat_interval_s": 0.3, "death_deadline_s": 0.9,
+}
+CHAOSB_ROUNDS = 3
+CHAOSB_DEADLINE_S = 3.0
+
+
+def _run_chaos_party(party: str, result_q) -> None:
+    """The robustness smoke: a quorum round under injected faults.
+
+    4 parties run ``run_fedavg_rounds(quorum=2, round_deadline_s=...)``
+    with a seeded chaos schedule: carol straggles 6s past the 3s round
+    deadline in round 1, and dave HARD-crashes at the same boundary
+    (``os._exit`` — sockets die, no goodbyes).  The gate: every
+    SURVIVING controller completes all rounds, agrees on the final
+    bytes, round 1 aggregated a strict quorum subset, and the roster
+    epoch advanced (the dead party was dropped without any runtime
+    restart).  This is the failure story the quorum/membership/chaos
+    machinery exists for, exercised over real sockets on every CI run.
+    """
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu import chaos
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    import jax
+    import jax.numpy as jnp
+
+    chaos.install({
+        "seed": 11,
+        "rules": [
+            {"hook": "round", "party": "carol", "match": {"round": 1},
+             "op": "delay_ms", "value": 8000},
+            {"hook": "round", "party": "dave", "match": {"round": 1},
+             "op": "crash_party"},
+        ],
+    })
+
+    dim = 1024
+    deltas = {p: 0.25 * (i + 1) for i, p in enumerate(CHAOSB_PARTIES)}
+
+    # Warm every jitted program the round touches: the first deadline
+    # must measure the protocol, not 4-way XLA compile contention.
+    params = {"w": jnp.zeros((dim,), jnp.float32)}
+    packed = fl_comp.compress(params, packed=True, wire_dtype=jnp.float32)
+    from rayfed_tpu.fl.fedavg import (
+        finalize_packed_stripe,
+        packed_weighted_sum,
+    )
+    from rayfed_tpu.fl.overlap import dga_correct
+    from rayfed_tpu.fl.streaming import DEFAULT_CHUNK_ELEMS, _accum_kernel
+
+    for n in (2, 3, 4):
+        packed_weighted_sum([packed] * n, None)
+    jax.block_until_ready(dga_correct(packed, packed, packed).buf)
+    kern = _accum_kernel(DEFAULT_CHUNK_ELEMS, "float32", "float32")
+    acc = kern(
+        jnp.zeros(DEFAULT_CHUNK_ELEMS, jnp.float32),
+        np.zeros(DEFAULT_CHUNK_ELEMS, np.float32),
+        np.int32(0), np.float32(1.0),
+    )
+    jax.block_until_ready(finalize_packed_stripe(acc, 2.0, dim, jnp.float32))
+
+    fed.init(
+        address="local", cluster=CHAOSB_CLUSTER, party=party,
+        enable_waiting_for_other_parties_ready=True,
+        peer_health_interval_in_seconds=1.0, peer_death_pings=3,
+        cross_silo_timeout_in_seconds=15,
+        cross_silo_retry_policy={
+            "maxAttempts": 2, "initialBackoff": "0.2s",
+            "maxBackoff": "0.5s",
+        },
+        recv_backstop_in_seconds=120,
+    )
+
+    @fed.remote
+    class Trainer:
+        def __init__(self, delta):
+            self._d = float(delta)
+
+        def train(self, p):
+            tree = fl_comp.decompress(p, jnp.float32)
+            return fl_comp.compress(
+                {"w": tree["w"] + self._d}, packed=True,
+                wire_dtype=jnp.float32,
+            )
+
+    trainers = {
+        p: Trainer.party(p).remote(deltas[p]) for p in CHAOSB_PARTIES
+    }
+    log: list = []
+    t0 = time.perf_counter()
+    try:
+        final = run_fedavg_rounds(
+            trainers, params, rounds=CHAOSB_ROUNDS, compress_wire=True,
+            packed_wire=True, wire_dtype=jnp.float32, quorum=2,
+            round_deadline_s=CHAOSB_DEADLINE_S, round_log=log,
+            coordinator=CHAOSB_PARTIES[0],
+        )
+    except chaos.ChaosPartyCrash:
+        # Hard crash: report, then die without any goodbye — the
+        # survivors' health monitors and quorum cutoff are the test.
+        # (The queue feeder thread must flush before os._exit or the
+        # report is lost with the process.)
+        if result_q is not None:
+            result_q.put((party, {"crashed": True}))
+            result_q.close()
+            result_q.join_thread()
+        os._exit(0)
+    wall = time.perf_counter() - t0
+    buf = np.asarray(final["w"], dtype=np.float32)
+    report = {
+        "crashed": False,
+        "rounds": len(log),
+        "round1_members": sorted(
+            next(e for e in log if e["round"] == 1)["members"]
+        ),
+        "final_crc": int(np.frombuffer(buf.tobytes(), np.uint8).sum()),
+        "final_head": float(buf[0]),
+        "epoch": int(log[-1]["epoch"]),
+        "wall_s": wall,
+    }
+    if result_q is not None:
+        result_q.put((party, report))
+    fed.shutdown()
+
+
+def _fill_chaos_extra(extra: dict, res: dict) -> None:
+    survivors = {p: r for p, r in res.items() if not r.get("crashed")}
+    crashed = [p for p, r in res.items() if r.get("crashed")]
+    finals = {(r["final_crc"], r["final_head"]) for r in survivors.values()}
+    extra["chaos_survivors"] = len(survivors)
+    extra["chaos_crashed_parties"] = crashed
+    extra["chaos_rounds_completed"] = min(
+        (r["rounds"] for r in survivors.values()), default=0
+    )
+    extra["chaos_round1_members"] = (
+        next(iter(survivors.values()))["round1_members"]
+        if survivors else []
+    )
+    extra["chaos_final_consistent"] = len(finals) == 1
+    extra["chaos_roster_epoch"] = max(
+        (r["epoch"] for r in survivors.values()), default=0
+    )
+    extra["chaos_round_wall_s"] = round(
+        max((r["wall_s"] for r in survivors.values()), default=0.0)
+        / max(1, CHAOSB_ROUNDS), 2,
+    )
+    _log(
+        f"  chaos: {len(survivors)} survivors completed "
+        f"{extra['chaos_rounds_completed']}/{CHAOSB_ROUNDS} rounds under "
+        f"1 straggler + 1 crash; round-1 quorum "
+        f"{extra['chaos_round1_members']}, roster epoch "
+        f"{extra['chaos_roster_epoch']}, finals "
+        f"{'IDENTICAL' if extra['chaos_final_consistent'] else 'DIVERGED'}"
+    )
+
+
 OVERLAPB_PARTIES = ("alice", "bob", "carol", "dave")
 OVERLAPB_CLUSTER = {
     p: {"address": f"127.0.0.1:{13120 + i}"}
@@ -2706,6 +2874,14 @@ def main() -> None:
                  "bundles, arena + multi-rail)...")
             sp = _one_child("_run_send_path_bench", ndev=1, timeout=420)
             _fill_send_path_extra(extra, sp)
+        with _section(extra, "chaos"):
+            _log("chaos smoke (quorum=2 rounds under injected straggler "
+                 "+ party crash, 4 parties)...")
+            cres = _multi_party(
+                "_run_chaos_party", parties=CHAOSB_PARTIES, ndev=1,
+                timeout=420,
+            )
+            _fill_chaos_extra(extra, cres)
         record = {
             "metric": "cross_party_stream_agg_GBps",
             "value": extra.get("cross_party_stream_agg_GBps", 0.0),
@@ -2720,6 +2896,7 @@ def main() -> None:
             or "ring_agg_error" in extra
             or "overlap_error" in extra
             or "send_path_error" in extra
+            or "chaos_error" in extra
         ):
             raise SystemExit(1)
         # CI gate (test.sh): the ring must actually de-bottleneck the
@@ -2765,6 +2942,31 @@ def main() -> None:
                 f"send-path smoke gate FAILED: "
                 f"send_vs_read_wall_ratio={wr} (must be <= 1.5; was "
                 f"2.7 in r05)"
+            )
+            raise SystemExit(1)
+        # CI gate (test.sh): the round must SURVIVE partial failure —
+        # under 1 injected straggler past the deadline + 1 hard party
+        # crash, every surviving controller completes every quorum
+        # round, they agree on the bytes, round 1 actually aggregated a
+        # strict subset (the cutoff fired), and the roster epoch
+        # advanced (the dead party was dropped, no runtime restart).
+        if (
+            extra.get("chaos_rounds_completed") != CHAOSB_ROUNDS
+            or extra.get("chaos_survivors") != len(CHAOSB_PARTIES) - 1
+            or not extra.get("chaos_final_consistent")
+            or not (
+                2 <= len(extra.get("chaos_round1_members", []))
+                < len(CHAOSB_PARTIES)
+            )
+            or extra.get("chaos_roster_epoch", 0) < 1
+        ):
+            _log(
+                f"chaos smoke gate FAILED: rounds="
+                f"{extra.get('chaos_rounds_completed')}/{CHAOSB_ROUNDS} "
+                f"survivors={extra.get('chaos_survivors')} "
+                f"consistent={extra.get('chaos_final_consistent')} "
+                f"round1_members={extra.get('chaos_round1_members')} "
+                f"epoch={extra.get('chaos_roster_epoch')}"
             )
             raise SystemExit(1)
         return

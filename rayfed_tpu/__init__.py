@@ -29,6 +29,8 @@ from rayfed_tpu.api import (
     remote,
     get,
     kill,
+    join,
+    leave,
     set_max_message_length,
 )
 from rayfed_tpu.exceptions import RemoteError
@@ -45,6 +47,8 @@ __all__ = [
     "remote",
     "get",
     "kill",
+    "join",
+    "leave",
     "send",
     "recv",
     "set_max_message_length",

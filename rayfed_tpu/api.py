@@ -106,6 +106,13 @@ def init(
     assert party, "Party should be provided."
     assert party in cluster, f"Party {party} is not in cluster {cluster}."
 
+    # Deterministic fault injection (tests/benches): a JSON schedule in
+    # $RAYFED_CHAOS arms the transport/driver chaos hooks for this
+    # process.  A no-op unless the variable is set.
+    from rayfed_tpu import chaos as _chaos
+
+    _chaos.maybe_install_from_env()
+
     fed_utils.validate_address(address)
     fed_utils.validate_cluster_info(cluster)
 
@@ -206,6 +213,10 @@ def init(
             mesh_provider=lambda: runtime.mesh,
             job_config=job_config,
             tls_config=tls_config,
+            # The party's advertised address IS the leader's listener:
+            # non-leaders watchdog it so leader death poisons their
+            # parked bridge recvs within the death deadline.
+            leader_address=cluster_config.party_config(party).address,
         )
         # A fatal bridge republish is a send failure for watchdog
         # purposes: exit-on-failure applies to the intra-party bridge too.
@@ -270,6 +281,34 @@ def set_max_message_length(max_bytes: int) -> None:
     # The manager also updates runtime.job_config (the same object), so
     # future clients inherit the new cap — one writer, no duplicate here.
     transport.set_max_message_size(int(max_bytes))
+
+
+def join(coordinator: Optional[str] = None,
+         timeout: Optional[float] = None) -> dict:
+    """(Re)join an in-progress quorum run — elastic membership's entry
+    door.  Sends a join request to the run's coordinator and parks until
+    its next round boundary returns the **welcome ticket** (round index,
+    session, roster epoch — applied to this runtime before returning —
+    and the current global model).  Pass the ticket to
+    ``fl.run_fedavg_rounds(..., quorum=k, join_ticket=ticket)`` to enter
+    the loop; no other party restarts anything.  See
+    :mod:`rayfed_tpu.fl.quorum`.
+    """
+    from rayfed_tpu.fl.quorum import join_cluster
+
+    return join_cluster(coordinator=coordinator, timeout=timeout)
+
+
+def leave() -> None:
+    """Gracefully leave an in-progress quorum run at the next round
+    boundary.  The departure is announced by the coordinator (roster
+    epoch advance) and this party's ``run_fedavg_rounds`` returns the
+    last broadcast model once the roster drops it — it still
+    participates in the round in flight.  See
+    :mod:`rayfed_tpu.fl.quorum`."""
+    from rayfed_tpu.fl.quorum import request_leave
+
+    request_leave()
 
 
 def shutdown() -> None:

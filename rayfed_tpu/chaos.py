@@ -1,0 +1,301 @@
+"""Deterministic chaos injection for the transport and round drivers.
+
+Every fault story this framework claims to survive — stragglers past the
+round deadline, a party crashing mid-round, a dropped or corrupted frame,
+a dead rail, a leader dying under a multi-host party — must be
+*exercisable on demand* or the claim rots.  This module is the single
+switchboard: a **seeded fault schedule** installed per process (or per
+in-process simulated party) fires at **named hook points** threaded
+through the transport client/server/manager and the federated round
+driver.  With no schedule installed every hook is one ``is None`` check —
+production pays nothing.
+
+Activation:
+
+- ``RAYFED_CHAOS`` environment variable holding the JSON schedule —
+  picked up by :func:`maybe_install_from_env` (called from ``fed.init``);
+- or :func:`install` directly from tests/benches (supports multiple
+  in-process simulated parties because every rule carries a ``party``
+  filter and every hook site reports the acting party).
+
+Schedule format::
+
+    {
+      "seed": 0,
+      "rules": [
+        {"hook": "round", "party": "carol", "match": {"round": 1},
+         "op": "delay_ms", "value": 4000},
+        {"hook": "round", "party": "dave", "match": {"round": 1},
+         "op": "crash_party"},
+        {"hook": "frame", "party": "alice", "match": {"dest": "bob"},
+         "count": 1, "op": "corrupt_crc"}
+      ]
+    }
+
+Rule fields:
+
+- ``hook``: one of the :data:`HOOKS` catalog below.
+- ``party``: only fire in the party named (omit = any).  In-process
+  multi-party simulations pass the acting party at every hook site, so
+  one process-global schedule drives all simulated parties.
+- ``match``: exact-match filters against the hook's context fields
+  (``round``, ``dest``, ``src``, ``up`` ...); ``stream`` matches by
+  ``fnmatch`` glob.  Omitted fields match anything.
+- ``after``: skip the first N matching events (default 0).
+- ``count``: fire at most N times (default 1; ``null`` = unbounded).
+- ``op`` + ``value``: the fault (see below).
+
+Ops:
+
+- ``delay_ms`` — sleep ``value`` ms (or draw uniformly from a two-element
+  ``[lo, hi]`` with the schedule's seeded rng: deterministic per rule).
+  At async hook sites the sleep is awaited, so only the injected path
+  stalls, not the whole event loop.
+- ``drop_frame`` — raise :class:`ChaosFault` (a ``ConnectionError``
+  subclass, so client retry arms treat it exactly like a lost wire).
+- ``corrupt_crc`` — flip the low bit of the frame's declared checksum
+  (``ctx["header"]``: ``crc``/``ccrc``) so the receiver's verification
+  fails and the sender's retry path runs.  The payload bytes are never
+  touched — injected corruption must not poison a reused send arena.
+- ``kill_rail`` — raise ``ConnectionResetError`` (connection-open and
+  per-frame sites: one rail dies, the payload-as-a-unit retry runs).
+- ``crash_party`` — raise :class:`ChaosPartyCrash`.  Only meaningful at
+  driver-level hooks (``round``): the test/bench harness turns it into a
+  hard process exit (or, in-process, an abrupt transport stop) so peers
+  see sockets die, not a graceful goodbye.
+
+Hook catalog (:data:`HOOKS`) — ``hook name: (site, context fields)``:
+
+- ``connect`` — ``TransportClient._open_conn`` before dialing
+  (``dest``): ``delay_ms``, ``kill_rail``.
+- ``send`` — ``TransportClient.send_data`` entry (``dest``, ``stream``,
+  ``up``, ``down``): ``delay_ms``, ``drop_frame``.
+- ``frame`` — ``TransportClient._roundtrip`` before a DATA frame's bytes
+  hit the socket (``dest``, ``header`` mutable): ``delay_ms``,
+  ``drop_frame``, ``corrupt_crc``, ``kill_rail``.
+- ``server_frame`` — ``TransportServer`` dispatch of a received DATA
+  frame (``src``, ``up``, ``down``): ``drop_frame`` (frame discarded
+  without an ACK — the sender times out and retries).
+- ``round`` — the federated round driver at each round boundary
+  (``round``): ``delay_ms`` (a straggler), ``crash_party``.
+- ``republish`` — the multi-host leader's bridge republish
+  (``pid``, ``up``, ``down``): ``drop_frame``, ``delay_ms``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "RAYFED_CHAOS"
+
+HOOKS = ("connect", "send", "frame", "server_frame", "round", "republish")
+
+_OPS = ("delay_ms", "drop_frame", "corrupt_crc", "kill_rail", "crash_party")
+
+
+class ChaosFault(ConnectionError):
+    """An injected transport fault (retryable, like a lost wire)."""
+
+
+class ChaosPartyCrash(BaseException):
+    """An injected party crash.
+
+    Subclasses ``BaseException`` so no retry ladder or broad
+    ``except Exception`` swallows it — a crash must unwind the whole
+    driver, the way a real SIGKILL would.  Raised only from driver-level
+    hooks (``round``); the harness decides how hard to die
+    (``os._exit`` in subprocess harnesses, an abrupt transport stop
+    in-process).
+    """
+
+
+class _Rule:
+    __slots__ = (
+        "hook", "party", "match", "after", "count", "op", "value",
+        "fired", "seen", "rng",
+    )
+
+    def __init__(self, spec: Dict[str, Any], index: int, seed: int) -> None:
+        self.hook = spec["hook"]
+        if self.hook not in HOOKS:
+            raise ValueError(
+                f"unknown chaos hook {self.hook!r}; known: {HOOKS}"
+            )
+        self.op = spec["op"]
+        if self.op not in _OPS:
+            raise ValueError(
+                f"unknown chaos op {self.op!r}; known: {_OPS}"
+            )
+        self.party = spec.get("party")
+        self.match = dict(spec.get("match") or {})
+        self.after = int(spec.get("after", 0))
+        count = spec.get("count", 1)
+        self.count = None if count is None else int(count)
+        self.value = spec.get("value")
+        self.seen = 0
+        self.fired = 0
+        # Rule-local deterministic rng (e.g. delay drawn from [lo, hi]):
+        # independent of firing order across rules.
+        self.rng = random.Random((int(seed) << 8) ^ index)
+
+    def matches(self, party: Optional[str], ctx: Dict[str, Any]) -> bool:
+        if self.party is not None and party != self.party:
+            return False
+        for key, want in self.match.items():
+            got = ctx.get(key)
+            if key == "stream":
+                if not isinstance(got, str) or not fnmatch.fnmatch(
+                    got, str(want)
+                ):
+                    return False
+            elif got != want:
+                return False
+        return True
+
+    def delay_s(self) -> float:
+        v = self.value
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            v = self.rng.uniform(float(v[0]), float(v[1]))
+        return float(v or 0) / 1e3
+
+
+class ChaosSchedule:
+    """A parsed, counter-tracking fault schedule (thread-safe)."""
+
+    def __init__(self, spec: Dict[str, Any]) -> None:
+        seed = int(spec.get("seed", 0))
+        self.seed = seed
+        self.rules: List[_Rule] = [
+            _Rule(r, i, seed) for i, r in enumerate(spec.get("rules", []))
+        ]
+        self._lock = threading.Lock()
+
+    def pick(self, hook: str, party: Optional[str], ctx: Dict[str, Any]):
+        """The first armed rule matching this event, advancing counters."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.hook != hook or not rule.matches(party, ctx):
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.count is not None and rule.fired >= rule.count:
+                    continue
+                rule.fired += 1
+                return rule
+        return None
+
+
+_ACTIVE: Optional[ChaosSchedule] = None
+
+
+def install(spec: Any) -> ChaosSchedule:
+    """Install a schedule process-wide (dict or JSON string)."""
+    global _ACTIVE
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    sched = spec if isinstance(spec, ChaosSchedule) else ChaosSchedule(spec)
+    _ACTIVE = sched
+    logger.warning(
+        "CHAOS schedule installed (%d rules, seed %d) — fault injection "
+        "is ACTIVE in this process", len(sched.rules), sched.seed,
+    )
+    return sched
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def installed() -> Optional[ChaosSchedule]:
+    return _ACTIVE
+
+
+def maybe_install_from_env() -> Optional[ChaosSchedule]:
+    """Install from ``RAYFED_CHAOS`` if set (idempotent; ``fed.init``
+    calls this so subprocess harnesses configure chaos via env)."""
+    import os
+
+    if _ACTIVE is not None:
+        return _ACTIVE
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return None
+    return install(raw)
+
+
+def _apply(rule: _Rule, hook: str, party: Optional[str],
+           ctx: Dict[str, Any]) -> Optional[float]:
+    """Apply a rule's non-sleep effect; returns seconds to sleep (the
+    caller sleeps — sync sites block the thread, async sites await)."""
+    label = f"chaos[{hook}:{rule.op}]"
+    if rule.op == "delay_ms":
+        delay = rule.delay_s()
+        logger.warning("%s party=%s delaying %.0f ms (ctx=%s)",
+                       label, party, delay * 1e3, _ctx_brief(ctx))
+        return delay
+    logger.warning("%s party=%s firing (ctx=%s)", label, party,
+                   _ctx_brief(ctx))
+    if rule.op == "drop_frame":
+        raise ChaosFault(f"{label}: injected frame drop")
+    if rule.op == "kill_rail":
+        raise ConnectionResetError(f"{label}: injected rail death")
+    if rule.op == "crash_party":
+        raise ChaosPartyCrash(f"{label}: injected crash of {party!r}")
+    if rule.op == "corrupt_crc":
+        header = ctx.get("header")
+        if isinstance(header, dict):
+            if isinstance(header.get("ccrc"), list) and header["ccrc"]:
+                header["ccrc"] = [header["ccrc"][0] ^ 1] + header["ccrc"][1:]
+            elif "crc" in header:
+                header["crc"] = int(header["crc"]) ^ 1
+            else:
+                # No checksum on this frame — declare a wrong one so the
+                # receiver still exercises its mismatch path.
+                header["crc"] = 1
+    return None
+
+
+def _ctx_brief(ctx: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in ctx.items() if k != "header"}
+
+
+def fire(hook: str, party: Optional[str] = None, **ctx: Any) -> None:
+    """Synchronous hook point.  No-op (one attribute read) without an
+    installed schedule.  May sleep the calling thread, mutate
+    ``ctx["header"]``, or raise the injected fault."""
+    sched = _ACTIVE
+    if sched is None:
+        return
+    rule = sched.pick(hook, party, ctx)
+    if rule is None:
+        return
+    delay = _apply(rule, hook, party, ctx)
+    if delay:
+        time.sleep(delay)
+
+
+async def fire_async(hook: str, party: Optional[str] = None,
+                     **ctx: Any) -> None:
+    """Awaitable twin of :func:`fire` for event-loop hook sites — an
+    injected delay parks only this coroutine, never the loop."""
+    sched = _ACTIVE
+    if sched is None:
+        return
+    rule = sched.pick(hook, party, ctx)
+    if rule is None:
+        return
+    delay = _apply(rule, hook, party, ctx)
+    if delay:
+        import asyncio
+
+        await asyncio.sleep(delay)

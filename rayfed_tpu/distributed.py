@@ -123,7 +123,19 @@ class PartyProcessGroup:
         )
 
     def barrier(self, name: str, timeout_s: float = 120.0) -> None:
-        self._client.wait_at_barrier(name, int(timeout_s * 1000))
+        """Party-wide barrier with a DEADLINE and a named failure: the
+        raw KV barrier error is a bare status string — wrap it so the
+        operator learns which barrier, which process, and how long it
+        waited (the missing processes are whichever never arrived)."""
+        try:
+            self._client.wait_at_barrier(name, int(timeout_s * 1000))
+        except Exception as e:
+            raise RuntimeError(
+                f"party process barrier {name!r} failed on process "
+                f"{self.process_id}/{self.num_processes} after waiting "
+                f"{timeout_s:.0f}s — at least one party process never "
+                f"arrived (or already failed): {e}"
+            ) from e
 
     def cleanup(self) -> None:
         """Best-effort removal of bridge keys (leader, at shutdown) so a
@@ -170,6 +182,7 @@ class MultiHostTransport:
         mesh_provider=None,
         job_config=None,
         tls_config: Optional[Dict] = None,
+        leader_address: Optional[str] = None,
     ) -> None:
         self._inner = inner
         self._group = group
@@ -178,6 +191,13 @@ class MultiHostTransport:
         self._timeout_s = timeout_s
         self._job = job_config
         self._tls_config = tls_config
+        # The party's advertised cross-party address — which is the
+        # LEADER's listener.  Non-leaders run a watchdog against it so
+        # leader death mid-round poisons their parked bridge recvs
+        # within the death deadline instead of the recv backstop.
+        self._leader_address = leader_address
+        self._watchdog_task = None
+        self._nl_roster = None  # lazy non-leader roster stub
         self._bridge_mgr = None  # non-leader listener
         self._bridge_clients: Dict[int, Any] = {}  # leader: pid -> client
         self._bridge_ready = threading.Event()
@@ -200,6 +220,7 @@ class MultiHostTransport:
             self._start_leader_bridge()
         else:
             self._start_member_bridge(mesh_provider)
+            self._start_leader_watchdog()
 
     # -- bridge wiring ---------------------------------------------------------
 
@@ -239,6 +260,101 @@ class MultiHostTransport:
         port = self._bridge_mgr._server.bound_port
         self._group.publish_bridge_address(f"{_local_host_ip()}:{port}")
         self._bridge_ready.set()
+
+    def _start_leader_watchdog(self) -> None:
+        """Non-leader: monitor the LEADER's cross-party listener.
+
+        The leader is every non-leader's single source of cross-party
+        values; when it dies mid-round the bridge mailbox's parked
+        recvs used to wait out the full recv backstop.  The watchdog
+        pings the leader's transport (the party's advertised address)
+        on the bridge manager's loop and, after ``peer_death_pings``
+        consecutive failures, fails every parked bridge waiter —
+        leader death now surfaces on the member within the death
+        deadline, as a :class:`~rayfed_tpu.exceptions.RemoteError`
+        naming the leader.  Like the main health monitor, a leader
+        that was never reachable only parks recvs (startup skew), and
+        monitoring continues so waiters that park AFTER the death are
+        failed on the next cycle too.
+        """
+        if self._leader_address is None or self._bridge_mgr is None:
+            return
+        from rayfed_tpu.config import JobConfig, RetryPolicy
+        from rayfed_tpu.transport import tls as tls_utils
+        from rayfed_tpu.transport.client import TransportClient
+
+        mgr = self._bridge_mgr
+        job = self._job if self._job is not None else JobConfig()
+        if not job.peer_failfast:
+            return
+        interval = job.peer_health_interval_s
+        threshold = max(1, int(job.peer_death_pings))
+        client = TransportClient(
+            src_party=mgr._party,
+            dest_party="party-leader",
+            address=self._leader_address,
+            retry_policy=RetryPolicy(max_attempts=1),
+            timeout_s=job.cross_silo_timeout_s,
+            max_message_size=job.cross_silo_messages_max_size,
+            ssl_context=tls_utils.client_ssl_context(self._tls_config),
+            loop=mgr._loop,
+        )
+
+        async def _watch():
+            from rayfed_tpu.exceptions import RemoteError
+
+            fails = 0
+            ever_reachable = False
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    ok = await asyncio.wait_for(
+                        client.ping(
+                            timeout_s=min(1.0, interval), ctl=True
+                        ),
+                        timeout=interval,
+                    )
+                except Exception:
+                    ok = False
+                if ok:
+                    ever_reachable = True
+                    fails = 0
+                    continue
+                if not ever_reachable:
+                    continue
+                fails += 1
+                if fails < threshold:
+                    continue
+                mailbox = mgr._mailbox
+                waiting = sorted(mailbox.parties_with_waiters())
+                if not waiting:
+                    continue
+                logger.warning(
+                    "party leader at %s unreachable (%d consecutive "
+                    "pings); failing %d parked bridge recvs",
+                    self._leader_address, fails, len(waiting),
+                )
+                err = RemoteError(
+                    "party-leader",
+                    "ConnectionError",
+                    f"this party's leader process "
+                    f"({self._leader_address}) is unreachable "
+                    f"({fails} consecutive pings over "
+                    f"~{fails * interval:.0f}s) — the bridge cannot "
+                    f"deliver cross-party values; the SPMD program "
+                    f"cannot proceed",
+                ).to_wire()
+                for party in waiting:
+                    # poison_new=False: the loop keeps running, so
+                    # waiters that park after this cycle are failed on
+                    # the next one — and a recovered leader resumes
+                    # cleanly with nothing to un-poison.
+                    mailbox.fail_party(party, err, poison_new=False)
+
+        def _arm():
+            self._watchdog_task = mgr._loop.create_task(_watch())
+
+        mgr._loop.call_soon_threadsafe(_arm)
 
     def _start_leader_bridge(self) -> None:
         """Install the republish hook, start the wire, and resolve
@@ -350,6 +466,33 @@ class MultiHostTransport:
                     "bridge republish to p%d failed (up=%s down=%s)",
                     pid, message.upstream_seq_id, message.downstream_seq_id,
                 )
+                # Poison the key ON the member: when the bridge itself
+                # is reachable but this payload can't cross it (e.g. it
+                # exceeds the bridge's message cap), the member's recv
+                # must RAISE a RemoteError naming the failure instead
+                # of hanging until its backstop.  A fully unreachable
+                # bridge fails this too — then the member-side leader
+                # watchdog is the backstop.
+                try:
+                    from rayfed_tpu.exceptions import RemoteError
+
+                    await client.send_data(
+                        [],
+                        message.upstream_seq_id,
+                        message.downstream_seq_id,
+                        error=RemoteError(
+                            "party-leader",
+                            "BridgeRepublishError",
+                            f"leader failed to republish "
+                            f"({message.upstream_seq_id}, "
+                            f"{message.downstream_seq_id}) to party "
+                            f"process {pid}: {e}",
+                        ).to_wire(),
+                    )
+                except Exception:
+                    logger.exception(
+                        "bridge republish poison to p%d also failed", pid
+                    )
                 if self.failure_handler is not None:
                     try:
                         self.failure_handler(LocalRef.from_value(False), e)
@@ -359,7 +502,7 @@ class MultiHostTransport:
     # -- proxy interface ------------------------------------------------------
 
     def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
-             stream=None, round_tag=None):
+             stream=None, round_tag=None, epoch_tag=None):
         if self._inner is not None:
             return self._inner.send(
                 dest_party=dest_party,
@@ -368,12 +511,14 @@ class MultiHostTransport:
                 downstream_seq_id=downstream_seq_id,
                 stream=stream,
                 round_tag=round_tag,
+                epoch_tag=epoch_tag,
             )
         # Non-leader: the leader's identical program does the real push.
         return LocalRef.from_value(True)
 
     def send_many(self, dest_parties, data, upstream_seq_id,
-                  downstream_seq_id, stream=None, round_tag=None):
+                  downstream_seq_id, stream=None, round_tag=None,
+                  epoch_tag=None):
         """Fan-out broadcast (one shared encode) — leader only; see
         :meth:`TransportManager.send_many`."""
         if self._inner is not None:
@@ -384,6 +529,7 @@ class MultiHostTransport:
                 downstream_seq_id=downstream_seq_id,
                 stream=stream,
                 round_tag=round_tag,
+                epoch_tag=epoch_tag,
             )
         return {p: LocalRef.from_value(True) for p in dest_parties}
 
@@ -451,6 +597,24 @@ class MultiHostTransport:
         if self._inner is not None:
             return self._inner.ping(dest_party, timeout_s)
         return True  # non-leaders have no cross-party wire to check
+
+    @property
+    def roster(self):
+        """The party's roster-epoch object (elastic membership) — the
+        leader's real one; non-leaders get a local stub (quorum rounds
+        are leader-driven, like streaming aggregation)."""
+        if self._inner is not None:
+            return self._inner.roster
+        if self._nl_roster is None:
+            from rayfed_tpu.transport.manager import RosterState
+
+            self._nl_roster = RosterState([])
+        return self._nl_roster
+
+    def drain_membership_requests(self) -> list:
+        if self._inner is not None:
+            return self._inner.drain_membership_requests()
+        return []
 
     def set_max_message_size(self, max_bytes: int) -> None:
         """Runtime message-size cap mutation — NOT supported for

@@ -12,7 +12,24 @@ transport round-trip time instead of parking until the recv backstop.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
+
+
+class PartyWaitTimeout(TimeoutError):
+    """A bounded wait on other parties expired, naming who was missing.
+
+    Raised by deadline-bounded cross-party waits (streaming-aggregation
+    sinks, quorum cutoffs that cannot reach *k*, parked recvs) instead
+    of a bare ``TimeoutError`` — the first question at 3am is always
+    "which party", so the exception answers it.
+    """
+
+    def __init__(self, message: str,
+                 missing_parties: Optional[Sequence[str]] = None) -> None:
+        self.missing_parties = sorted(missing_parties or [])
+        if self.missing_parties:
+            message = f"{message} (missing parties: {self.missing_parties})"
+        super().__init__(message)
 
 
 class RemoteError(RuntimeError):

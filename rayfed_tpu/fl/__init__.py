@@ -34,6 +34,11 @@ from rayfed_tpu.fl.fedavg import (
     tree_weighted_sum,
 )
 from rayfed_tpu.fl.overlap import PipelinedRoundRunner, dga_correct
+from rayfed_tpu.fl.quorum import (
+    QuorumRoundError,
+    quorum_aggregate,
+    run_quorum_rounds,
+)
 from rayfed_tpu.fl.ring import RingRoundError, ring_aggregate
 from rayfed_tpu.fl.streaming import (
     StreamingAggregator,
@@ -62,6 +67,9 @@ __all__ = [
     "streaming_aggregate",
     "ring_aggregate",
     "RingRoundError",
+    "QuorumRoundError",
+    "quorum_aggregate",
+    "run_quorum_rounds",
     "PipelinedRoundRunner",
     "dga_correct",
     "StreamingAggregator",

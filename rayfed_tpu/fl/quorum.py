@@ -1,0 +1,687 @@
+"""Quorum (k-of-n) federated rounds + elastic party membership.
+
+Every aggregation path built so far — coordinator, streaming, ring,
+overlap — assumes a fixed roster where every party answers every round:
+one slow or dead silo stalls or aborts the round for everyone.  This
+module makes the round **survive partial failure**:
+
+- **Quorum rounds** (``run_fedavg_rounds(quorum=k, round_deadline_s=d)``):
+  the coordinator aggregates the first *k* of *n* contributions per
+  round; once the deadline passes (or the stragglers provably cannot
+  arrive) it stops waiting and reweights by the arrived Σw
+  (:class:`~rayfed_tpu.fl.streaming.StreamingAggregator` quorum cutoff).
+  The aggregate over the member subset *M* is exactly
+  ``Σ_{p∈M} w_p·x_p / Σ_{p∈M} w_p`` — bit-identical to
+  ``packed_weighted_sum`` over the subset in sorted-party order.
+
+- **Late fold, not drop**: a straggler whose round-*r* contribution
+  missed the cutoff still receives the round-*r* broadcast; its local
+  progress ``Δ = u_r − input_r`` folds into its round-*r+1* starting
+  point via the PR-4 DGA recurrence
+  (:func:`~rayfed_tpu.fl.overlap.dga_correct`):
+  ``input_{r+1} = agg_r + (u_r − input_r)`` — the party resyncs onto the
+  global model while its work survives into the next round's
+  contribution.  No party ever diverges: everyone's base is the same
+  broadcast.
+
+- **Elastic membership**: the live roster is an epoch-numbered object on
+  the transport (:class:`~rayfed_tpu.transport.manager.RosterState`).
+  ``fed.join()`` / ``fed.leave()`` / monitor-declared death advance the
+  epoch **at a round boundary**, announced by the coordinator in the
+  round broadcast so every controller applies the identical transition —
+  no consensus protocol, no fed-runtime restart on churn.  Quorum-round
+  frames are stamped with their sender's epoch
+  (``wire.EPOCH_TAG_KEY``) and STALE-epoch frames are rejected loudly
+  (newer-epoch frames pass: the advanced coordinator's broadcast is
+  what carries the roster transition to lagging stragglers).
+
+- **Ring rounds honor the quorum** too: ``mode="ring"`` runs the
+  chunk-striped ring as usual; a straggler or death aborts the ring
+  (its existing poison cascade) and the round re-aggregates over the
+  coordinator topology **with the quorum cutoff** — the straggler is
+  excluded there instead of failing the round.
+
+Determinism without the global seq counter: every rendezvous key of a
+quorum round is derived from ``(session, stream, round index)`` — so a
+party that crashed and rejoined only needs the round index (from its
+join welcome) to re-align, with no shared counter to reconstruct.  The
+session id itself is drawn once per run from the ordinary seq stream
+(identical on every non-joining controller) and handed to joiners in
+the welcome.
+
+Coordinator caveat: the quorum round's coordinator is a single point of
+failure for the round (the ring mode spreads the *bytes*, but its
+fallback and the membership announcements still anchor at the
+coordinator).  Surviving coordinator death is future work; pick a
+reliable party via ``run_fedavg_rounds(coordinator=...)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from rayfed_tpu import chaos
+
+logger = logging.getLogger(__name__)
+
+
+class QuorumRoundError(RuntimeError):
+    """A quorum round failed on this controller (quorum unreachable,
+    coordinator death, broadcast lost)."""
+
+
+class QuorumRoundOutcome:
+    """One quorum round's result on this controller."""
+
+    __slots__ = ("result", "members", "announce", "welcomes")
+
+    def __init__(self, result: Any, members: List[str],
+                 announce: Optional[Dict[str, Any]],
+                 welcomes: List[Tuple[str, str]]):
+        self.result = result  # aggregated PackedTree
+        self.members = members  # parties whose contributions made the cut
+        self.announce = announce  # {"epoch", "members"} roster advance or None
+        self.welcomes = welcomes  # coordinator only: [(party, nonce)] joiners
+
+
+def _round_key(session: str, stream: str, r: int) -> str:
+    return f"q.{session}.{stream}.{r}"
+
+
+def _poison_round_key(runtime, parties, up, down, exc) -> None:
+    """Best-effort poison of one promised rendezvous key on every
+    listed party — peers parked on it raise the coordinator's error
+    within a round trip instead of waiting out their backstop."""
+    poison = getattr(runtime.transport, "_send_poison", None)
+    if poison is None:
+        return
+    for p in parties:
+        try:
+            poison(p, up, down, exc)
+        except Exception:  # pragma: no cover - best effort
+            logger.exception("failed to poison quorum key for %s", p)
+
+
+def quorum_aggregate(
+    runtime,
+    updates: Dict[str, Any],
+    weights: Optional[Dict[str, float]],
+    *,
+    session: str,
+    round_index: int,
+    quorum: int,
+    deadline_s: Optional[float],
+    coordinator: str,
+    stream: str,
+    epoch: int,
+    announce_fn: Optional[Callable[[List[str]], tuple]] = None,
+    backstop: Optional[float] = None,
+    timings: Optional[Dict[str, float]] = None,
+) -> QuorumRoundOutcome:
+    """One k-of-n streaming round over the coordinator topology.
+
+    ``updates``: ``{party: FedObject}`` for the round's active roster
+    (sorted-party order defines the fold order).  Every active
+    controller calls this at the same program point; the coordinator
+    decides the member set (quorum cutoff) and broadcasts
+    ``{"d": aggregate, "m": members, "a": roster announcement}`` — the
+    one value every controller agrees on.
+
+    ``announce_fn(members) -> (announce | None, welcomes)`` runs on the
+    coordinator after the cutoff: it drains join/leave requests, folds
+    in monitor-declared deaths, and advances the roster — the driver
+    supplies it so this function stays transport-pure.
+    """
+    from rayfed_tpu.proxy import recv_on_runtime
+
+    me = runtime.party
+    parties = sorted(updates)
+    down = _round_key(session, stream, round_index)
+    backstop = (
+        backstop if backstop is not None
+        else runtime.job_config.recv_backstop_s
+    )
+    t0 = time.perf_counter()
+
+    # Quorum control-plane sends go DIRECTLY through the transport, not
+    # proxy.send_on_runtime: that helper registers every ref with the
+    # cleanup send-watchdog, and with exit_on_failure_cross_silo_sending
+    # a PROTOCOL-TOLERATED failure (an epoch-rejected late push, a
+    # broadcast to a just-crashed party) would SIGTERM a perfectly
+    # healthy process.  Partial failure is this path's normal weather.
+    if me != coordinator:
+        obj = updates[me]
+        runtime.send_proxy.send(
+            coordinator, obj.get_local_ref(), f"{down}.up.{me}",
+            down, stream=f"{stream}/up/{me}", round_tag=round_index,
+            epoch_tag=epoch,
+        )
+        # The push result is deliberately not awaited as a success
+        # gate: a late push may be epoch-rejected (the membership
+        # advanced) — that is the protocol working, not a failure; the
+        # local progress folds into the next round via dga_correct.
+        try:
+            value = recv_on_runtime(
+                runtime, coordinator, f"{down}.down", down
+            ).resolve(timeout=backstop)
+        except BaseException as exc:
+            raise QuorumRoundError(
+                f"round {round_index}: result broadcast from coordinator "
+                f"{coordinator!r} failed: {exc!r}"
+            ) from exc
+        if timings is not None:
+            timings["agg_s"] = time.perf_counter() - t0
+        return QuorumRoundOutcome(
+            value["d"], list(value["m"]), value.get("a"), []
+        )
+
+    # -- coordinator ---------------------------------------------------------
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    idx = {p: i for i, p in enumerate(parties)}
+    w_list = (
+        None if weights is None else [float(weights[p]) for p in parties]
+    )
+    agg = StreamingAggregator(
+        len(parties),
+        weights=w_list,
+        allowed=runtime.cluster_config.serializing_allowed_list,
+        quorum=min(int(quorum), len(parties)),
+        labels=parties,
+    )
+    sink_entries = []
+    cancel_keys = []
+    for p in parties:
+        if p == me:
+            local_ref = updates[p].get_local_ref()
+
+            def _feed(ref, i=idx[p]):
+                exc = ref.exception()
+                if exc is not None:
+                    # The coordinator's own training failed — survivable
+                    # under quorum, like any other party's failure.
+                    agg._on_error(i, exc)
+                else:
+                    agg.add_local(i, ref.resolve())
+
+            local_ref.add_done_callback(_feed)
+        else:
+            sink_entries.append(
+                (p, f"{down}.up.{p}", down, agg.sink(idx[p]))
+            )
+            cancel_keys.append((p, f"{down}.up.{p}", down))
+    if sink_entries:
+        runtime.transport.recv_stream_many(sink_entries)
+    others = [p for p in parties if p != me]
+    try:
+        result = agg.result(timeout=backstop, deadline_s=deadline_s)
+        members = [parties[i] for i in agg.quorum_members]
+        # Excluded stragglers' sinks must not linger: an armed sink
+        # keeps the health monitor probing its source forever, and a
+        # very late payload would park unread.  Cancelled sinks drop
+        # late frames into the mailbox where the TTL GC bounds them.
+        member_set = set(members)
+        for p, up, dwn in cancel_keys:
+            if p not in member_set:
+                runtime.transport.cancel_stream(up, dwn)
+        # Inside the poison-protected block deliberately: announce_fn
+        # can raise (a coordinator fed.leave, a roster conflict), and
+        # the peers are ALREADY parked on the broadcast — they must
+        # hear about any coordinator-side failure promptly, whatever
+        # stage it happened at.
+        announce, welcomes = (None, [])
+        if announce_fn is not None:
+            announce, welcomes = announce_fn(members)
+    except BaseException as exc:
+        # Peers are parked on the broadcast — poison it so they learn
+        # the round died now, not at their backstop.
+        _poison_round_key(runtime, others, f"{down}.down", down, exc)
+        for _p, up, dwn in cancel_keys:
+            runtime.transport.cancel_stream(up, dwn)
+        raise QuorumRoundError(
+            f"round {round_index}: quorum aggregation failed: {exc!r}"
+        ) from exc
+    payload = {"d": result, "m": members, "a": announce}
+    refs = runtime.send_proxy.send_many(
+        others, payload, f"{down}.down", down,
+        stream=f"{stream}/down", round_tag=round_index, epoch_tag=epoch,
+    )
+    delivered = 0
+    for p, ref in refs.items():
+        if ref.resolve(timeout=backstop):
+            delivered += 1
+        else:
+            # Dead or just-crashed party: its recv will fail via the
+            # health monitor, and a rejoin resyncs from a welcome — the
+            # surviving members' round must not abort for it.
+            logger.warning(
+                "round %d: result broadcast to %s failed (dead or "
+                "departed party?)", round_index, p,
+            )
+    if timings is not None:
+        timings["agg_s"] = time.perf_counter() - t0
+    return QuorumRoundOutcome(result, members, announce, welcomes)
+
+
+def _coordinator_announce_fn(
+    runtime, trainers: Dict[str, Any], active: List[str],
+):
+    """Build the coordinator's per-round roster-transition hook.
+
+    Returns ``announce_fn(members)`` for :func:`quorum_aggregate`: it
+    drains join/leave requests from the membership inbox, drops parties
+    that are both monitor-declared dead AND missed the round, and
+    advances the roster epoch when the set changed.  Join requests
+    always produce a welcome (a restarted party still on the roster
+    needs one to resync even though the member set is unchanged).
+    """
+    transport = runtime.transport
+    roster = transport.roster
+
+    def announce_fn(members: List[str]):
+        joins: Dict[str, str] = {}
+        leaves = set()
+        for req in transport.drain_membership_requests():
+            op, p = req.get("op"), req.get("party")
+            if op == "join" and p in trainers:
+                joins[p] = str(req.get("nonce", ""))
+            elif op == "leave" and p:
+                leaves.add(p)
+            else:
+                logger.warning(
+                    "ignoring malformed membership request: %r", req
+                )
+        if roster.consume_leave_request():
+            raise QuorumRoundError(
+                "the quorum coordinator cannot leave the roster "
+                "(coordinator handover is not supported); run with "
+                "coordinator= pinned to a party that stays"
+            )
+        dead = set(transport.get_stats().get("dead_parties", ()))
+        # Drop only parties that BOTH missed the round and are declared
+        # dead — a straggler that merely missed the cutoff stays a
+        # member (its progress folds into the next round).
+        dropped = (set(active) - set(members)) & dead
+        new_members = (set(active) - dropped - leaves) | set(joins)
+        announce = None
+        if new_members != set(active):
+            epoch = roster.advance(sorted(new_members))
+            announce = {"epoch": epoch, "members": sorted(new_members)}
+        return announce, [(p, n) for p, n in sorted(joins.items())]
+
+    return announce_fn
+
+
+def run_quorum_rounds(
+    trainers: Dict[str, Any],
+    params: Any,
+    rounds: int,
+    *,
+    quorum: int,
+    round_deadline_s: Optional[float],
+    weights: Optional[Sequence[float]] = None,
+    coordinator: Optional[str] = None,
+    wire_dtype: Any = None,
+    mode: str = "coordinator",
+    ring_chunk_elems: Optional[int] = None,
+    on_round: Optional[Callable[[int, Any], None]] = None,
+    timings: Optional[list] = None,
+    stream: str = "fedavg",
+    join_ticket: Optional[Dict[str, Any]] = None,
+    round_log: Optional[list] = None,
+) -> Any:
+    """The quorum-mode round loop behind ``run_fedavg_rounds(quorum=k)``.
+
+    Differences from the classic loop:
+
+    - aggregation is always the quorum-aware streaming round
+      (:func:`quorum_aggregate`); ``mode="ring"`` tries the ring first
+      and falls back to it when the ring aborts;
+    - each party's next-round input is the broadcast aggregate — except
+      a straggler's, which is ``dga_correct(agg, update, input)`` so its
+      missed progress folds into the next round;
+    - the active set is the live roster (epoch-advanced at round
+      boundaries by coordinator announcements); a party that finds
+      itself off the roster returns its last broadcast (graceful
+      ``fed.leave``) — a dropped-as-dead party that is in fact alive
+      must ``fed.join()`` to re-enter;
+    - ``weights`` align with ``sorted(trainers)`` and are subset per
+      round to the active members;
+    - ``join_ticket``: the welcome returned by ``fed.join()`` — the
+      (re)joining controller starts at the welcome's round from the
+      welcome's params, with the welcome's roster epoch already applied.
+    - ``round_log``: optional list receiving one ``{"round", "epoch",
+      "active", "members"}`` dict per round — the audit trail of who was
+      on the roster and who made each round's quorum (tests and the
+      chaos bench replay the exact FedAvg recurrence from it).
+    """
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl.compression import PackedTree, compress, decompress
+    from rayfed_tpu.fl.overlap import dga_correct
+    from rayfed_tpu.runtime import get_runtime
+
+    runtime = get_runtime()
+    transport = runtime.transport
+    roster = getattr(transport, "roster", None)
+    if roster is None:
+        raise QuorumRoundError(
+            "this transport has no roster (quorum rounds need the "
+            "single-process TransportManager or a multi-host leader)"
+        )
+    me = runtime.party
+    all_parties = sorted(trainers)
+    cluster_parties = sorted(runtime.cluster_config.parties)
+    if all_parties != cluster_parties:
+        # Observer (non-trainer) controllers are supported by the
+        # classic aggregation paths but NOT yet by quorum rounds: the
+        # roster, the broadcast fan-out and the membership
+        # announcements all equate "cluster party" with "training
+        # party".  Fail loudly instead of KeyError-ing mid-round.
+        raise QuorumRoundError(
+            f"quorum rounds require every cluster party to train: "
+            f"trainers {all_parties} vs cluster {cluster_parties} — "
+            f"observer controllers are not supported with quorum= "
+            f"(use the classic aggregation paths there)"
+        )
+    coord = coordinator if coordinator is not None else min(trainers)
+    w_map = (
+        None if weights is None
+        else dict(zip(all_parties, [float(w) for w in weights]))
+    )
+    import jax.numpy as _jnp
+
+    wire_dt = _jnp.bfloat16 if wire_dtype is None else wire_dtype
+    backstop = runtime.job_config.recv_backstop_s
+
+    if join_ticket is not None:
+        start_round = int(join_ticket["round"])
+        session = str(join_ticket["session"])
+        params = join_ticket["params"]
+    else:
+        start_round = 0
+        # One id per run, drawn identically on every (non-joining)
+        # controller — every rendezvous key of the run derives from it,
+        # so two runs in one process can never collide in the
+        # mailbox's consumed-key dedupe.
+        session = str(runtime.next_seq_id())
+
+    current = (
+        params if isinstance(params, PackedTree)
+        else compress(params, packed=True, wire_dtype=wire_dt)
+    )
+    late_inputs: Dict[str, Any] = {}
+    dga = fed.remote(dga_correct)
+
+    r = start_round
+    while r < rounds:
+        chaos.fire("round", party=me, round=r)
+        epoch, roster_members = roster.snapshot()
+        if me not in roster_members:
+            # We left (fed.leave announced) or were dropped as dead —
+            # exit gracefully with the last agreed model.
+            logger.info(
+                "[%s] off the roster at epoch %d; leaving the round "
+                "loop at round %d", me, epoch, r,
+            )
+            break
+        if me != coord and roster.consume_leave_request():
+            # fed.leave(): tell the coordinator; we participate until
+            # the announcement drops us (next boundary).  Direct
+            # transport send — see quorum_aggregate on why membership
+            # control traffic skips the cleanup send-watchdog.
+            nonce = uuid.uuid4().hex
+            runtime.send_proxy.send(
+                coord, {"op": "leave", "party": me, "nonce": nonce},
+                f"roster.req.{me}.{nonce}", "roster",
+            )
+        active = [p for p in all_parties if p in roster_members]
+        # A party that left the roster forfeits its pending late fold:
+        # a rejoin resyncs from the welcome's global model, and a stale
+        # correction from before the drop must never leak into it.
+        for p in list(late_inputs):
+            if p not in active:
+                late_inputs.pop(p)
+        if len(active) < quorum:
+            raise QuorumRoundError(
+                f"round {r}: live roster {active} is smaller than the "
+                f"quorum ({quorum}) — the run cannot make progress"
+            )
+        rec = None
+        if timings is not None:
+            rec = {"local_s": 0.0, "push_s": 0.0, "agg_s": 0.0,
+                   "hidden_s": 0.0}
+            t_r0 = time.perf_counter()
+        inputs = {p: late_inputs.pop(p, current) for p in active}
+        updates = {
+            p: trainers[p].train.remote(inputs[p]) for p in active
+        }
+        if rec is not None and me in updates:
+            my_ref = updates[me].get_local_ref()
+            if my_ref is not None:
+                my_ref.add_done_callback(
+                    lambda _ref, rec=rec, t0=t_r0: rec.__setitem__(
+                        "local_s", time.perf_counter() - t0
+                    )
+                )
+        announce_fn = (
+            _coordinator_announce_fn(runtime, trainers, active)
+            if me == coord else None
+        )
+        outcome = _aggregate_with_mode(
+            runtime, updates, w_map, session=session, round_index=r,
+            quorum=quorum, deadline_s=round_deadline_s, coordinator=coord,
+            stream=stream, epoch=epoch, mode=mode,
+            ring_chunk_elems=ring_chunk_elems, announce_fn=announce_fn,
+            backstop=backstop, active=active, timings=rec,
+        )
+        avg, members = outcome.result, outcome.members
+        # Stragglers fold their missed round-r progress into round r+1
+        # (DGA recurrence) instead of dropping it — each correction is a
+        # party-local fed task, no extra wire traffic.
+        for p in active:
+            if p not in members:
+                late_inputs[p] = dga.party(p).remote(
+                    avg, updates[p], inputs[p]
+                )
+        if outcome.announce is not None and me != coord:
+            roster.apply(
+                outcome.announce["epoch"], outcome.announce["members"]
+            )
+        if round_log is not None:
+            round_log.append({
+                "round": r, "epoch": epoch, "active": list(active),
+                "members": list(members),
+            })
+        current = avg
+        if rec is not None:
+            rec["agg_s"] = max(
+                0.0, rec.get("agg_s", 0.0) - rec["local_s"]
+            )
+            timings.append(rec)
+        if on_round is not None:
+            on_round(r, decompress(current))
+        if me == coord and outcome.welcomes:
+            _send_welcomes(
+                runtime, outcome.welcomes, roster, current, r + 1,
+                session, backstop,
+            )
+        r += 1
+    return decompress(current)
+
+
+def _aggregate_with_mode(
+    runtime, updates, w_map, *, session, round_index, quorum, deadline_s,
+    coordinator, stream, epoch, mode, ring_chunk_elems, announce_fn,
+    backstop, active, timings,
+) -> QuorumRoundOutcome:
+    """Ring-first aggregation when ``mode="ring"``: a straggler or dead
+    party aborts the ring on every controller (poison cascade + commit
+    ring), and the SAME round re-aggregates over the coordinator
+    topology with the quorum cutoff — the straggler is excluded there
+    instead of failing the round."""
+    from rayfed_tpu.proxy import recv_on_runtime
+
+    me = runtime.party
+    down = _round_key(session, stream, round_index)
+    if mode == "ring" and len(active) > 1:
+        from rayfed_tpu.fl.ring import RING_STATS, RingRoundError, ring_aggregate
+
+        try:
+            objs = [updates[p] for p in sorted(updates)]
+            result = ring_aggregate(
+                objs,
+                None if w_map is None
+                else [w_map[p] for p in sorted(updates)],
+                stream=f"{stream}/ring",
+                chunk_elems=ring_chunk_elems,
+                seq_ids=(f"{down}.rs", f"{down}.ag", f"{down}.c",
+                         f"{down}.rl", f"{down}.nm"),
+                round_tag=round_index,
+                timeout=deadline_s if deadline_s is not None else backstop,
+                expect_parties=active,
+                timings=timings,
+            )
+            members = list(active)
+            # The ring has no coordinator broadcast to carry roster
+            # announcements, so a tiny announce frame rides after every
+            # successful ring round (usually {"a": None}).
+            announce = None
+            welcomes: list = []
+            if me == coordinator:
+                try:
+                    if announce_fn is not None:
+                        announce, welcomes = announce_fn(members)
+                except BaseException as exc:
+                    # Peers are about to park on the announce key —
+                    # they must hear the coordinator-side failure (e.g.
+                    # a coordinator fed.leave) now, not at backstop.
+                    _poison_round_key(
+                        runtime, [p for p in active if p != me],
+                        f"{down}.ann", down, exc,
+                    )
+                    raise
+                refs = runtime.send_proxy.send_many(
+                    [p for p in active if p != me],
+                    {"a": announce}, f"{down}.ann", down,
+                    round_tag=round_index, epoch_tag=epoch,
+                )
+                for p, ref in refs.items():
+                    if not ref.resolve(timeout=backstop):
+                        logger.warning(
+                            "round %d: announce to %s failed",
+                            round_index, p,
+                        )
+            else:
+                ann = recv_on_runtime(
+                    runtime, coordinator, f"{down}.ann", down
+                ).resolve(timeout=backstop)
+                announce = ann.get("a")
+            return QuorumRoundOutcome(result, members, announce, welcomes)
+        except RingRoundError as exc:
+            logger.warning(
+                "round %d: ring aborted (%s); re-aggregating the same "
+                "round over the coordinator topology with quorum %d "
+                "cutoff", round_index, exc, quorum,
+            )
+            RING_STATS["fallback_rounds"] += 1
+            stream = f"{stream}.fb"
+    return quorum_aggregate(
+        runtime, updates, w_map, session=session, round_index=round_index,
+        quorum=quorum, deadline_s=deadline_s, coordinator=coordinator,
+        stream=stream, epoch=epoch, announce_fn=announce_fn,
+        backstop=backstop, timings=timings,
+    )
+
+
+def _send_welcomes(runtime, welcomes, roster, current, next_round,
+                   session, backstop) -> None:
+    """Coordinator: hand each joiner everything it needs to enter the
+    loop at the next round — round index, session, the current roster
+    epoch, and the current global model.  Best-effort: a joiner that
+    died again simply re-requests later.  Direct transport send —
+    see quorum_aggregate on why membership control traffic skips the
+    cleanup send-watchdog."""
+    epoch, members = roster.snapshot()
+    for party, nonce in welcomes:
+        payload = {
+            "round": int(next_round),
+            "session": session,
+            "epoch": int(epoch),
+            "members": list(members),
+            "params": current,
+        }
+        ref = runtime.send_proxy.send(
+            party, payload, f"roster.welcome.{party}.{nonce}", "roster",
+        )
+        if not ref.resolve(timeout=backstop):
+            logger.warning(
+                "welcome to rejoining party %s failed; it will have to "
+                "re-request", party,
+            )
+
+
+def join_cluster(
+    coordinator: Optional[str] = None, timeout: Optional[float] = None
+) -> Dict[str, Any]:
+    """(Re)join an in-progress quorum run — the ``fed.join()`` protocol.
+
+    Sends a join request to the coordinator's membership inbox, then
+    parks until the coordinator's next round boundary sends back the
+    **welcome**: ``{"round", "session", "epoch", "members", "params"}``.
+    The roster epoch from the welcome is applied to this runtime's
+    roster before returning, so epoch-tagged frames line up immediately.
+    Pass the returned ticket to ``run_fedavg_rounds(join_ticket=...)``
+    to enter the loop at the right round with the current global model —
+    no other party restarts anything.
+    """
+    from rayfed_tpu.proxy import recv_on_runtime
+    from rayfed_tpu.runtime import get_runtime
+
+    runtime = get_runtime()
+    me = runtime.party
+    coord = (
+        coordinator if coordinator is not None
+        else min(runtime.cluster_config.parties)
+    )
+    if coord == me:
+        raise ValueError(
+            "the coordinator cannot join its own run; pass the "
+            "coordinator the run is anchored at"
+        )
+    nonce = uuid.uuid4().hex
+    ref = runtime.send_proxy.send(
+        coord, {"op": "join", "party": me, "nonce": nonce},
+        f"roster.req.{me}.{nonce}", "roster",
+    )
+    backstop = (
+        timeout if timeout is not None
+        else runtime.job_config.recv_backstop_s
+    )
+    if not ref.resolve(timeout=backstop):
+        raise QuorumRoundError(
+            f"join request to coordinator {coord!r} could not be "
+            f"delivered"
+        )
+    welcome = recv_on_runtime(
+        runtime, coord, f"roster.welcome.{me}.{nonce}", "roster"
+    ).resolve(timeout=backstop)
+    runtime.transport.roster.apply(welcome["epoch"], welcome["members"])
+    logger.info(
+        "[%s] joined at round %d (roster epoch %d, members %s)",
+        me, welcome["round"], welcome["epoch"], welcome["members"],
+    )
+    return welcome
+
+
+def request_leave() -> None:
+    """Graceful departure — the ``fed.leave()`` half of elastic
+    membership.  Sets the roster's leave flag; the quorum round driver
+    picks it up at the next round boundary, tells the coordinator, and
+    this party exits its round loop once the announced roster drops it
+    (so it still participates in the round in flight)."""
+    from rayfed_tpu.runtime import get_runtime
+
+    get_runtime().transport.roster.request_leave()

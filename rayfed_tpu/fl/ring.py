@@ -190,6 +190,7 @@ def ring_aggregate(
     seq_ids: Optional[Sequence[int]] = None,
     round_tag: Optional[int] = None,
     timings: Optional[Dict[str, float]] = None,
+    expect_parties: Optional[Sequence[str]] = None,
 ) -> Any:
     """FedAvg round over the chunk-striped ring (see module docstring).
 
@@ -217,6 +218,14 @@ def ring_aggregate(
     (``wire.ROUND_TAG_KEY``).  ``timings`` (optional dict) receives
     ``push_s`` (reduce-scatter pushes ACKed) and ``agg_s`` (whole-call
     wall).
+
+    ``expect_parties``: the controllers expected to be LIVE this round
+    (default: the whole cluster config).  Elastic-membership callers
+    (``fl.quorum``) pass the current roster so a departed/dead party is
+    not treated as a non-member controller owed the result broadcast —
+    a checked send to a corpse would otherwise abort every ring round
+    after churn.  Must be identical on every controller (it is: the
+    roster is announcement-driven).
     """
     from rayfed_tpu.fed_object import FedObject
     from rayfed_tpu.fl.fedavg import (
@@ -281,7 +290,10 @@ def ring_aggregate(
         timeout if timeout is not None
         else runtime.job_config.recv_backstop_s
     )
-    parties = list(runtime.cluster_config.parties)
+    parties = (
+        list(expect_parties) if expect_parties is not None
+        else list(runtime.cluster_config.parties)
+    )
     non_members = [p for p in parties if p not in set(ring)]
 
     from rayfed_tpu.proxy import (

@@ -95,7 +95,7 @@ class _Stream:
     __slots__ = (
         "payload", "avail_bytes", "complete", "local_tree", "elems_array",
         "data_start", "data_nbytes", "dtype", "applied_blocks",
-        "t_complete", "notified_bytes", "manifest",
+        "t_complete", "notified_bytes", "manifest", "error",
     )
 
     def __init__(self) -> None:
@@ -111,6 +111,10 @@ class _Stream:
         self.t_complete = 0.0
         self.notified_bytes = 0
         self.manifest: Optional[Dict[str, Any]] = None  # parsed payload manifest
+        # Quorum mode only: this stream's own failure (dead source,
+        # verification failure) — recorded instead of failing the whole
+        # aggregation, as long as the quorum stays reachable.
+        self.error: Optional[BaseException] = None
 
 
 class _StreamSink:
@@ -162,9 +166,19 @@ class StreamingAggregator:
         allowed: Optional[Dict[str, Any]] = None,
         chunk_elems: int = DEFAULT_CHUNK_ELEMS,
         out_dtype: Any = None,
+        quorum: Optional[int] = None,
+        labels: Optional[Sequence[str]] = None,
     ) -> None:
         if n_sources < 1:
             raise ValueError("streaming aggregation needs >= 1 source")
+        if quorum is not None and not 1 <= int(quorum) <= n_sources:
+            raise ValueError(
+                f"quorum must be in [1, {n_sources}], got {quorum}"
+            )
+        if labels is not None and len(labels) != n_sources:
+            raise ValueError(
+                f"{len(labels)} labels for {n_sources} sources"
+            )
         if weights is not None:
             from rayfed_tpu.fl.fedavg import _check_weights
 
@@ -191,6 +205,24 @@ class StreamingAggregator:
         self._chunk_elems = int(chunk_elems)
         self._n = n_sources
         self._streams = [_Stream() for _ in range(n_sources)]
+        # Quorum (k-of-n) mode: the first k completed contributions may
+        # be aggregated without the rest once the deadline passes (or
+        # the rest provably cannot arrive).  None = classic all-of-n.
+        self._quorum = None if quorum is None else int(quorum)
+        self._labels = (
+            [str(x) for x in labels]
+            if labels is not None
+            else [f"source {i}" for i in range(n_sources)]
+        )
+        # Sorted indices of the contributions actually aggregated; None
+        # until a cutoff excludes someone (the all-of-n hot path never
+        # touches this).
+        self._participating: Optional[List[int]] = None
+        self._deadline_at: Optional[float] = None  # monotonic cutoff time
+        # Set by transport threads that need the fold rolled back (a
+        # corrupt mid-fold stream under quorum); consumed by the worker,
+        # the only thread allowed to touch the accumulator.
+        self._needs_reset = False
         self._cond = threading.Condition()
         self._acc = None
         self._total_elems = -1
@@ -294,6 +326,20 @@ class StreamingAggregator:
         now = time.perf_counter()
         with self._cond:
             s = self._streams[index]
+            if s.error is not None:
+                # A stream that failed earlier (corrupt mid-fold, a
+                # transient death) just delivered CLEAN bytes — the
+                # sender's retry or the party's revival won.  Clear the
+                # failure so the stream rejoins the fold pool: leaving
+                # it marked would stall the ordered fold chain at this
+                # index forever while the cutoff counts it complete.
+                # (Any poisoned partial folds were already queued for
+                # rollback when the error was recorded.)
+                logger.info(
+                    "contribution from %s recovered (clean retry after "
+                    "%s)", self._labels[index], s.error,
+                )
+                s.error = None
             # Delta frames (and mailbox replays) deliver a payload
             # object the incremental view never saw — rebind.
             s.payload = memoryview(payload)
@@ -314,7 +360,28 @@ class StreamingAggregator:
                 exc = RemoteError.from_wire(err)
             except Exception:
                 exc = RuntimeError(f"stream {index} failed: {err!r}")
-        self.fail(exc)
+        if self._quorum is None:
+            self.fail(exc)
+            return
+        # Quorum mode: one dead/failed contribution is survivable — mark
+        # the stream failed and let the cutoff logic aggregate the rest.
+        # Deliberately NO eager "quorum unreachable" verdict here: a
+        # stream error can be transient (a corrupt frame whose sender
+        # retries cleanly, a blip the monitor un-declares) and
+        # _on_complete clears it — the give-up decision belongs to the
+        # deadline (see _maybe_cutoff_locked), which is when stragglers
+        # have provably had their chance.
+        with self._cond:
+            s = self._streams[index]
+            if s.complete or s.error is not None:
+                return
+            s.error = exc
+            logger.warning(
+                "contribution from %s failed (%s); continuing toward "
+                "quorum %d/%d", self._labels[index], exc, self._quorum,
+                self._n,
+            )
+            self._cond.notify_all()
 
     @staticmethod
     def _reset_frame(s: _Stream) -> None:
@@ -339,32 +406,164 @@ class StreamingAggregator:
             if s.complete:
                 return
             if corrupt and s.applied_blocks > 0:
-                self._error = RuntimeError(
-                    f"contribution {index} failed verification after "
-                    f"{s.applied_blocks} of its blocks were already "
-                    f"aggregated — the donated accumulator cannot be "
-                    f"rolled back; re-run the round"
-                )
+                if self._quorum is not None:
+                    # Quorum mode can afford the rollback the donated
+                    # accumulator can't: zero it, forget every applied
+                    # block, mark the stream failed — the worker refolds
+                    # the healthy contributions from their retained
+                    # payloads (a reset also happens at any cutoff, so
+                    # this adds no new machinery).
+                    s.error = RuntimeError(
+                        f"contribution from {self._labels[index]} failed "
+                        f"verification mid-fold; excluded and refolding"
+                    )
+                    self._reset_frame(s)
+                    # The WORKER performs the actual rollback (it is the
+                    # only accumulator mutator — a reset from this
+                    # transport thread could race a fold in flight).
+                    self._needs_reset = True
+                else:
+                    self._error = RuntimeError(
+                        f"contribution {index} failed verification after "
+                        f"{s.applied_blocks} of its blocks were already "
+                        f"aggregated — the donated accumulator cannot be "
+                        f"rolled back; re-run the round"
+                    )
             else:
                 self._reset_frame(s)
             self._cond.notify_all()
 
+    def _reset_fold_locked(self) -> None:
+        """Zero the accumulator and forget all applied blocks (cutoff /
+        quorum rollback).  The retained payloads and local arrays are
+        the refold sources — pure local compute, no re-wire."""
+        if self._acc is not None:
+            import jax.numpy as jnp
+
+            self._acc = jnp.zeros(
+                self._nblocks * self._chunk_elems, jnp.float32
+            )
+        for s in self._streams:
+            s.applied_blocks = 0
+
+    def _maybe_cutoff_locked(self) -> None:
+        """Quorum cutoff decision (worker loop, under the lock): once
+        the deadline passes — or the stragglers provably cannot arrive —
+        with at least ``quorum`` contributions complete, pin the
+        participating set, reweight to its Σw, and refold.  The all-
+        arrived case never reaches here with a subset, so quorum=n with
+        no faults stays byte-identical to the classic path."""
+        if self._quorum is None or self._participating is not None:
+            return
+        # Ready = complete AND healthy: a stream can be complete with a
+        # still-standing error only transiently (a clean retry clears it
+        # in _on_complete), but the cutoff must never pin a failed
+        # stream into the participating set — its fold would stall the
+        # chain forever.
+        ready = [
+            i for i, s in enumerate(self._streams)
+            if s.complete and s.error is None
+        ]
+        if len(ready) == self._n:
+            return  # everyone made it — nothing to cut
+        failed = sum(1 for s in self._streams if s.error is not None)
+        deadline_hit = (
+            self._deadline_at is not None
+            and time.monotonic() >= self._deadline_at
+        )
+        if len(ready) < self._quorum:
+            # Quorum not met.  Give up only once the deadline has
+            # passed AND even the still-pending healthy streams could
+            # not fill it — failed streams get every chance to recover
+            # (a clean retry clears the error) until then; without a
+            # deadline the result() timeout is the bound, and its
+            # PartyWaitTimeout names whoever never arrived.
+            pending = self._n - len(ready) - failed
+            if (
+                deadline_hit
+                and len(ready) + pending < self._quorum
+                and self._error is None
+            ):
+                failed_names = [
+                    self._labels[i]
+                    for i, s in enumerate(self._streams)
+                    if s.error is not None
+                ]
+                exc: BaseException = RuntimeError(
+                    f"quorum {self._quorum}/{self._n} unreachable: only "
+                    f"{len(ready)} contributions arrived by the round "
+                    f"deadline and those from {failed_names} failed"
+                )
+                for i, s in enumerate(self._streams):
+                    if s.error is not None:
+                        exc.__cause__ = s.error
+                        break
+                self._error = exc
+                self._cond.notify_all()
+            return
+        if not deadline_hit and not (
+            failed and len(ready) + failed == self._n
+        ):
+            return
+        self._participating = ready  # sorted by construction
+        excluded = [
+            self._labels[i] for i in range(self._n) if i not in set(ready)
+        ]
+        logger.warning(
+            "quorum cutoff: aggregating %d/%d contributions "
+            "(excluded: %s); reweighting to the arrived sum",
+            len(ready), self._n, excluded,
+        )
+        if self._weights_arg is not None:
+            from rayfed_tpu.fl.fedavg import _check_weights
+
+            self._total_w = _check_weights(
+                [self._weights[i] for i in ready]
+            )
+        else:
+            self._total_w = float(len(ready))
+        # Partial folds may include excluded streams' blocks (the fold
+        # is per-arrival) — restart from zero over the participating set
+        # in party order, which is exactly packed_weighted_sum over the
+        # subset.
+        self._reset_fold_locked()
+
     # -- result ---------------------------------------------------------------
 
-    def result(self, timeout: Optional[float] = None):
+    def result(self, timeout: Optional[float] = None,
+               deadline_s: Optional[float] = None):
         """Block until every contribution streamed in; the aggregate as a
         :class:`~rayfed_tpu.fl.compression.PackedTree` in the wire dtype
-        (``unpack``/``decompress`` restores the compute-dtype tree)."""
+        (``unpack``/``decompress`` restores the compute-dtype tree).
+
+        ``deadline_s`` (quorum mode only): seconds from THIS call after
+        which the wait stops for stragglers — once at least ``quorum``
+        contributions are complete, the worker cuts the round over to
+        the arrived set (reweighted to its Σw) instead of waiting out
+        ``timeout``.  Cutoff granularity is the worker's wake interval
+        (≤ 0.5 s past the deadline)."""
+        if deadline_s is not None and self._quorum is None:
+            raise ValueError("deadline_s needs quorum= at construction")
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
+            if deadline_s is not None and self._deadline_at is None:
+                self._deadline_at = time.monotonic() + float(deadline_s)
+                self._cond.notify_all()  # worker re-times its waits
             while not self._done and self._error is None:
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        self._error = TimeoutError(
+                        from rayfed_tpu.exceptions import PartyWaitTimeout
+
+                        self._error = PartyWaitTimeout(
                             f"streaming aggregation timed out after "
-                            f"{timeout}s"
+                            f"{timeout}s",
+                            missing_parties=[
+                                self._labels[i]
+                                for i, s in enumerate(self._streams)
+                                if not s.complete
+                            ],
                         )
                         self._cond.notify_all()
                         break
@@ -372,6 +571,16 @@ class StreamingAggregator:
             if self._error is not None:
                 raise self._error
             return self._result
+
+    @property
+    def quorum_members(self) -> List[int]:
+        """Sorted indices of the contributions the aggregate includes
+        (all of them unless a quorum cutoff excluded stragglers).
+        Meaningful once :meth:`result` returned."""
+        with self._cond:
+            if self._participating is not None:
+                return list(self._participating)
+            return list(range(self._n))
 
     @property
     def agg_overlap_frac(self) -> float:
@@ -501,10 +710,25 @@ class StreamingAggregator:
             with self._cond:
                 if self._error is not None:
                     return
+                if self._needs_reset:
+                    self._needs_reset = False
+                    self._reset_fold_locked()
+                self._maybe_cutoff_locked()
+                # The fold set: all streams, or the pinned quorum subset
+                # after a cutoff (excluded stragglers are ignored even
+                # if their bytes keep arriving).
+                order = (
+                    self._participating
+                    if self._participating is not None
+                    else list(range(self._n))
+                )
                 # Snapshot availability; validate layouts lazily.
                 work: List[tuple] = []
                 try:
-                    for i, s in enumerate(self._streams):
+                    for i in order:
+                        s = self._streams[i]
+                        if s.error is not None:
+                            continue
                         if s.dtype is None and not self._parse_layout(s):
                             continue
                         if self._acc is None:
@@ -527,14 +751,24 @@ class StreamingAggregator:
                     return
                 if self._acc is not None:
                     # Party-order-per-block schedule: stream i may fold
-                    # block b only once streams 0..i-1 folded theirs —
-                    # the result is then independent of arrival order.
+                    # block b only once every EARLIER fold-set stream
+                    # folded theirs — the result is then independent of
+                    # arrival order (and, after a cutoff, identical to
+                    # packed_weighted_sum over the participating subset).
                     # The chunk source is snapshotted HERE, under the
                     # lock (see _chunk_np).
-                    for i, s in enumerate(self._streams):
+                    prev: Optional[int] = None
+                    for i in order:
+                        s = self._streams[i]
+                        if s.error is not None:
+                            # Pre-cutoff: a failed stream stalls its
+                            # successors until the cutoff excludes it
+                            # (partial sums must not skip a party that
+                            # the cutoff might still... never include).
+                            break
                         limit = (
-                            self._streams[i - 1].applied_blocks
-                            if i else self._nblocks
+                            self._streams[prev].applied_blocks
+                            if prev is not None else self._nblocks
                         )
                         target = min(self._avail_blocks(s), limit)
                         if target > s.applied_blocks:
@@ -543,18 +777,33 @@ class StreamingAggregator:
                                 (s.elems_array, s.payload, s.dtype,
                                  s.data_start),
                             ))
-                all_complete = all(s.complete for s in self._streams)
+                        prev = i
+                all_complete = all(
+                    self._streams[i].complete for i in order
+                ) and (self._participating is not None
+                       or not any(s.error is not None
+                                  for s in self._streams))
                 if not work:
                     if all_complete and self._acc is not None and all(
-                        s.applied_blocks == self._nblocks
-                        for s in self._streams
+                        self._streams[i].applied_blocks == self._nblocks
+                        for i in order
                     ):
                         break  # everything folded — finalize below
-                    self._cond.wait(timeout=0.5)
+                    wait_s = 0.5
+                    if (
+                        self._deadline_at is not None
+                        and self._participating is None
+                    ):
+                        wait_s = min(
+                            wait_s,
+                            max(0.05,
+                                self._deadline_at - time.monotonic()),
+                        )
+                    self._cond.wait(timeout=wait_s)
                     continue
                 if all_complete and not self._t_all_complete:
                     self._t_all_complete = max(
-                        s.t_complete for s in self._streams
+                        self._streams[i].t_complete for i in order
                     )
             # Apply outside the lock (sinks keep landing bytes meanwhile).
             if kernel is None:
@@ -591,6 +840,10 @@ class StreamingAggregator:
                 0.0, self._t_all_complete - self._t_first_byte
             ),
             "agg_overlap_frac": min(1.0, max(0.0, 1.0 - tail_s / busy)),
+            "quorum_excluded": (
+                0 if self._participating is None
+                else self._n - len(self._participating)
+            ),
         }
         with self._cond:
             self._result = result
@@ -611,17 +864,26 @@ class StreamingAggregator:
             self._acc, self._total_w, self._total_elems, out_dt
         )
         out_buf.block_until_ready()
+        members = (
+            self._participating
+            if self._participating is not None
+            else list(range(self._n))
+        )
         template = self._template_tree()
         passthrough = template.passthrough
         if passthrough:
             # Non-float leaves get the same per-leaf averaging the
             # one-shot path applies (every payload is still retained as
             # a zero-copy view, so decoding the skeletons is cheap).
+            # After a quorum cutoff only the participating trees reduce,
+            # with the matching weight subset.
             from rayfed_tpu.fl.fedavg import _reduce_passthrough
 
             passthrough = _reduce_passthrough(
-                [t.passthrough for t in map(self._tree_of, self._streams)],
-                self._weights_arg,
+                [self._tree_of(self._streams[i]).passthrough
+                 for i in members],
+                None if self._weights_arg is None
+                else [self._weights[i] for i in members],
                 self._total_w,
             )
         spec = template.spec
@@ -646,10 +908,15 @@ class StreamingAggregator:
         return tree
 
     def _template_tree(self):
-        for s in self._streams:
-            if s.local_tree is not None:
-                return s.local_tree
-        return self._tree_of(self._streams[0])
+        members = (
+            self._participating
+            if self._participating is not None
+            else list(range(self._n))
+        )
+        for i in members:
+            if self._streams[i].local_tree is not None:
+                return self._streams[i].local_tree
+        return self._tree_of(self._streams[members[0]])
 
 
 class StripeAggregator(StreamingAggregator):

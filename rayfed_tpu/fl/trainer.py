@@ -69,6 +69,10 @@ def run_fedavg_rounds(
     overlap: bool = False,
     timings: Optional[list] = None,
     ring_chunk_elems: Optional[int] = None,
+    quorum: Optional[int] = None,
+    round_deadline_s: Optional[float] = None,
+    join_ticket: Optional[dict] = None,
+    round_log: Optional[list] = None,
 ) -> Any:
     """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
 
@@ -175,6 +179,30 @@ def run_fedavg_rounds(
       granularity (``mode="ring"`` only; every controller must pass the
       same value — tests use it to stripe small models).
 
+    - ``quorum``: **k-of-n rounds** — the round aggregates as soon as at
+      least ``quorum`` contributions arrived once ``round_deadline_s``
+      passes (or the stragglers provably cannot arrive), reweighted to
+      the arrived Σw; a straggler's missed contribution folds into its
+      NEXT round via the DGA correction instead of being dropped, and
+      the live roster (``fed.join``/``fed.leave``/monitor-declared
+      death) advances by coordinator announcement at round boundaries —
+      see :mod:`rayfed_tpu.fl.quorum`.  Requires ``compress_wire`` +
+      ``packed_wire``; with ``quorum=len(trainers)`` and no faults the
+      result is byte-identical to the streaming path.  Composes with
+      ``mode="ring"`` (a ring abort re-aggregates the round over the
+      coordinator topology with the quorum cutoff).  Incompatible with
+      ``server_opt``/``aggregator``/``sample``/``error_feedback``/
+      ``overlap``/``checkpointer`` (each needs the exact fixed-roster
+      synchronous boundary).
+    - ``round_deadline_s``: the straggler cutoff for quorum rounds (and
+      the per-wait deadline of quorum-mode ring rounds).  Without it a
+      quorum round only cuts over when missing parties are DECLARED
+      dead by the health monitor.
+    - ``join_ticket``: the welcome dict returned by ``fed.join()`` — a
+      (re)joining controller enters the in-progress quorum run at the
+      welcome's round with the welcome's params; all other arguments
+      must match the running controllers'.
+
     Without a server optimizer the rounds **pipeline**: the averaged
     model flows into the next round as a lazy ``FedObject`` (no
     ``fed.get`` barrier) and only the final round materializes.  A
@@ -265,6 +293,52 @@ def run_fedavg_rounds(
             "ring_chunk_elems only applies to mode='ring' (it sets the "
             "ring stripe grid granularity)"
         )
+    if quorum is not None:
+        if not 1 <= int(quorum) <= len(trainers):
+            raise ValueError(
+                f"quorum must be in [1, {len(trainers)}], got {quorum}"
+            )
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "quorum requires compress_wire=True and packed_wire=True "
+                "(the quorum cutoff and the DGA late fold run on the "
+                "packed wire buffer)"
+            )
+        incompat = {
+            "server_opt": server_opt is not None,
+            "aggregator": aggregator is not None,
+            "sample": sample is not None and sample != len(trainers),
+            "error_feedback": error_feedback,
+            "overlap": overlap,
+            "checkpointer": checkpointer is not None,
+        }
+        bad = [k for k, v in incompat.items() if v]
+        if bad:
+            raise ValueError(
+                f"quorum is incompatible with {bad}: each needs the "
+                "exact fixed-roster synchronous round boundary that "
+                "k-of-n cutoffs and elastic membership give up"
+            )
+    if round_deadline_s is not None:
+        if quorum is None:
+            raise ValueError(
+                "round_deadline_s only applies with quorum= (it is the "
+                "straggler cutoff of k-of-n rounds)"
+            )
+        if not round_deadline_s > 0:
+            raise ValueError(
+                f"round_deadline_s must be > 0, got {round_deadline_s}"
+            )
+    if join_ticket is not None and quorum is None:
+        raise ValueError(
+            "join_ticket only applies with quorum= (elastic membership "
+            "rides the quorum round protocol)"
+        )
+    if round_log is not None and quorum is None:
+        raise ValueError(
+            "round_log only applies with quorum= (the classic loop has "
+            "a fixed roster — there is nothing to log)"
+        )
     if overlap:
         if not (compress_wire and packed_wire):
             raise ValueError(
@@ -335,6 +409,27 @@ def run_fedavg_rounds(
     import jax.numpy as _jnp
 
     wire_dt = _jnp.bfloat16 if wire_dtype is None else wire_dtype
+
+    if quorum is not None:
+        # k-of-n rounds with elastic membership own their loop shape
+        # (roster-driven active set, DGA late folds, round-index-derived
+        # rendezvous keys) — see fl/quorum.py.
+        from rayfed_tpu.fl.quorum import run_quorum_rounds
+
+        return run_quorum_rounds(
+            trainers, params, rounds,
+            quorum=int(quorum),
+            round_deadline_s=round_deadline_s,
+            weights=weights,
+            coordinator=coord,
+            wire_dtype=wire_dt,
+            mode=mode,
+            ring_chunk_elems=ring_chunk_elems,
+            on_round=on_round,
+            timings=timings,
+            join_ticket=join_ticket,
+            round_log=round_log,
+        )
 
     if overlap:
         # The pipelined engine owns its own loop shape (double-buffered

@@ -22,12 +22,15 @@ def send_on_runtime(
     downstream_seq_id: Any,
     stream: Any = None,
     round_tag: Any = None,
+    epoch_tag: Any = None,
 ) -> LocalRef:
     """``stream``: stable stream name enabling the transport's per-peer
     delta cache (ship only changed chunks — see TransportClient).
     ``round_tag``: federated round index stamped into the frame metadata
     (``wire.ROUND_TAG_KEY``) so in-flight pipelined rounds stay
-    attributable — see :meth:`TransportManager.send`."""
+    attributable — see :meth:`TransportManager.send`.  ``epoch_tag``:
+    roster epoch stamped into the metadata (``wire.EPOCH_TAG_KEY``;
+    cross-epoch frames are rejected loudly by the receiver)."""
     if runtime.send_proxy is None:
         raise RuntimeError("transport not started; call fed.init() first")
     result_ref = runtime.send_proxy.send(
@@ -37,6 +40,7 @@ def send_on_runtime(
         downstream_seq_id=downstream_seq_id,
         stream=stream,
         round_tag=round_tag,
+        epoch_tag=epoch_tag,
     )
     if runtime.cleanup_manager is not None:
         runtime.cleanup_manager.push_to_sending(result_ref)
@@ -51,6 +55,7 @@ def send_many_on_runtime(
     downstream_seq_id: Any,
     stream: Any = None,
     round_tag: Any = None,
+    epoch_tag: Any = None,
 ) -> dict:
     """Broadcast fan-out: ONE payload encode shared by every destination.
 
@@ -69,6 +74,7 @@ def send_many_on_runtime(
         downstream_seq_id=downstream_seq_id,
         stream=stream,
         round_tag=round_tag,
+        epoch_tag=epoch_tag,
     )
     if runtime.cleanup_manager is not None:
         for ref in refs.values():

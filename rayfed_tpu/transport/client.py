@@ -28,6 +28,7 @@ import struct
 import time
 from typing import Any, Dict, List, Optional
 
+from rayfed_tpu import chaos
 from rayfed_tpu.config import RetryPolicy
 from rayfed_tpu.transport import wire
 
@@ -232,6 +233,7 @@ class TransportClient:
         pool_size: int = 2,
         loop: Optional[asyncio.AbstractEventLoop] = None,
         stripe_rails: Optional[int] = None,
+        dead_check: Optional[Any] = None,
     ) -> None:
         if checksum is None:
             # Match the manager's policy: checksum only when the fast C++
@@ -248,6 +250,14 @@ class TransportClient:
         self._host = host
         self._port = int(port)
         self._retry_policy = retry_policy
+        # Known-dead fast-fail: () -> bool, True while the destination
+        # is declared dead by the health monitor (the manager wires the
+        # mailbox's dead-party snapshot in).  A send still makes ONE
+        # attempt — the snapshot lags recovery by up to a ping cycle —
+        # but the backoff ladder is skipped: retrying a corpse burns the
+        # full ladder (measured 65 s on poison pushes) for nothing, and
+        # the monitor's pings, not sends, are what detect revival.
+        self._dead_check = dead_check
         self._timeout_s = timeout_s
         self._max_message_size = max_message_size
         self._metadata = dict(metadata or {})
@@ -331,6 +341,10 @@ class TransportClient:
     # -- connection management ------------------------------------------------
 
     async def _open_conn(self) -> _Conn:
+        if chaos.installed() is not None:
+            await chaos.fire_async(
+                "connect", party=self._src_party, dest=self._dest_party
+            )
         reader, writer = await asyncio.open_connection(
             self._host,
             self._port,
@@ -505,6 +519,15 @@ class TransportClient:
             conn = await self._acquire_conn()
         rid = next(self._rid)
         header = dict(header, rid=rid)
+        if msg_type == wire.MSG_DATA and chaos.installed() is not None:
+            # Chaos "frame" hook: may delay this frame, drop it (raises
+            # a retryable ChaosFault), kill the rail, or corrupt the
+            # DECLARED checksum in the (mutable) header so the
+            # receiver's verification + the sender's retry path run.
+            await chaos.fire_async(
+                "frame", party=self._src_party, dest=self._dest_party,
+                header=header,
+            )
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         conn.pending[rid] = fut
@@ -693,6 +716,24 @@ class TransportClient:
         self.stats["send_crc_s"] += crc_s
         self.stats["send_socket_s"] += write_s
         self.stats["send_frame_wall_s"] += time.perf_counter() - t_frame0
+
+    def _dest_known_dead(self) -> bool:
+        """True while the health monitor has the destination declared
+        dead — the retry ladders consult this and stop immediately
+        instead of sleeping out the backoff sequence."""
+        if self._dead_check is None:
+            return False
+        try:
+            return bool(self._dead_check())
+        except Exception:  # pragma: no cover - monitor accessor bug
+            return False
+
+    def _dead_fast_fail(self, last_exc: Optional[Exception]) -> None:
+        raise SendError(
+            f"destination {self._dest_party!r} is declared dead by the "
+            f"health monitor; skipping the retry backoff ladder "
+            f"(last attempt: {last_exc})"
+        ) from last_exc
 
     @property
     def checksum_enabled(self) -> bool:
@@ -928,6 +969,8 @@ class TransportClient:
         last_exc: Optional[Exception] = None
         for attempt in range(max(1, policy.max_attempts)):
             if attempt:
+                if self._dest_known_dead():
+                    self._dead_fast_fail(last_exc)
                 backoff = policy.next_backoff(backoff)
                 logger.debug(
                     "[%s] retrying striped send to %s in %.2fs "
@@ -994,7 +1037,14 @@ class TransportClient:
     ) -> str:
         """See :meth:`_send_data_impl` — this wrapper only maintains the
         whole-operation in-flight count :meth:`has_inflight_sends`
-        reads (the message-cap mutation guard)."""
+        reads (the message-cap mutation guard) and the chaos "send"
+        hook (whole-payload delay/drop injection)."""
+        if chaos.installed() is not None:
+            await chaos.fire_async(
+                "send", party=self._src_party, dest=self._dest_party,
+                stream=stream, up=str(upstream_seq_id),
+                down=str(downstream_seq_id),
+            )
         self._inflight_sends += 1
         try:
             return await self._send_data_impl(
@@ -1090,6 +1140,8 @@ class TransportClient:
         last_exc: Optional[Exception] = None
         for attempt in range(max(1, policy.max_attempts)):
             if attempt:
+                if self._dest_known_dead():
+                    self._dead_fast_fail(last_exc)
                 # Decorrelated jitter (policy.jitter, default on): N
                 # parties retrying the same dead peer must not wake in
                 # lockstep.  The chosen delay is logged so a retry storm
@@ -1431,6 +1483,8 @@ class TransportClient:
                     )
                     if attempt >= max(1, policy.max_attempts):
                         break
+                    if self._dest_known_dead():
+                        self._dead_fast_fail(last_exc)
                     backoff = policy.next_backoff(backoff)
                     logger.debug(
                         "[%s] retrying stream send to %s in %.2fs",

@@ -39,7 +39,7 @@ logger = logging.getLogger(__name__)
 # operator typo like "tiemout_s" then just... did nothing).
 _KNOWN_TRANSPORT_OPTIONS = frozenset(
     {"timeout_s", "max_message_size", "checksum", "connections_per_peer",
-     "stripe_rails"}
+     "stripe_rails", "heartbeat_interval_s", "death_deadline_s"}
 )
 # Reference-style gRPC channel-arg keys accepted for drop-in compat.
 _COMPAT_TRANSPORT_OPTIONS = {
@@ -48,6 +48,136 @@ _COMPAT_TRANSPORT_OPTIONS = {
 # Recognized-but-inapplicable: there is no gRPC authority to override
 # on a raw socket transport.  Reported with the ignored keys.
 _INAPPLICABLE_TRANSPORT_OPTIONS = frozenset({"grpc.default_authority"})
+
+
+def _validate_health_knobs(heartbeat_s: float, deadline_s: float) -> None:
+    """Shared validation of the per-party health-monitor options
+    (``heartbeat_interval_s`` / ``death_deadline_s``) — surfaced through
+    ``effective_transport_options`` instead of living as module
+    constants, and validated wherever they enter."""
+    if not (heartbeat_s > 0):
+        raise ValueError(
+            f"heartbeat_interval_s must be > 0, got {heartbeat_s}"
+        )
+    if deadline_s < heartbeat_s:
+        raise ValueError(
+            f"death_deadline_s ({deadline_s}) must be >= "
+            f"heartbeat_interval_s ({heartbeat_s}) — a deadline shorter "
+            f"than one heartbeat would declare every party dead on its "
+            f"first missed ping"
+        )
+
+
+class RosterState:
+    """Epoch-numbered live-membership view (elastic party membership).
+
+    The cluster config stays the static universe of parties that COULD
+    participate; the roster is the subset that currently DOES, stamped
+    with a monotonically increasing **epoch**.  Epochs advance only at
+    round boundaries, announced by the quorum round's coordinator in its
+    result broadcast (``fl.quorum``) — every controller applies the same
+    announcement, so the roster is identical everywhere without a
+    consensus protocol.  ``fed.join()`` / ``fed.leave()`` / monitor-
+    declared death all funnel through those announcements; no fed
+    runtime restarts on churn.
+
+    Frames of quorum rounds are stamped with the sender's epoch
+    (``wire.EPOCH_TAG_KEY``) and the receiving server rejects
+    cross-epoch frames loudly — see ``TransportServer.epoch_provider``.
+
+    Thread-safe: read from the transport loop (epoch checks), driver
+    threads, and the health monitor.
+    """
+
+    def __init__(self, members: Sequence[str]) -> None:
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._members = tuple(sorted(members))
+        self._leave_requested = False
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def members(self) -> tuple:
+        with self._lock:
+            return self._members
+
+    def snapshot(self) -> tuple:
+        """``(epoch, members)`` read atomically."""
+        with self._lock:
+            return self._epoch, self._members
+
+    def is_member(self, party: str) -> bool:
+        with self._lock:
+            return party in self._members
+
+    def apply(self, epoch: int, members: Sequence[str]) -> bool:
+        """Apply an announced roster; returns True if it advanced.
+
+        Stale announcements (epoch older than current) are ignored with
+        a warning — a late broadcast replay must not roll membership
+        back.  An equal-epoch announcement with DIFFERENT members is a
+        protocol bug and raises.
+        """
+        epoch = int(epoch)
+        members = tuple(sorted(members))
+        with self._lock:
+            if epoch < self._epoch:
+                logger.warning(
+                    "ignoring stale roster announcement (epoch %d < "
+                    "current %d)", epoch, self._epoch,
+                )
+                return False
+            if epoch == self._epoch:
+                if members != self._members:
+                    raise ValueError(
+                        f"conflicting rosters for epoch {epoch}: "
+                        f"{members} vs {self._members}"
+                    )
+                return False
+            logger.info(
+                "roster epoch %d -> %d: members %s -> %s",
+                self._epoch, epoch, self._members, members,
+            )
+            self._epoch = epoch
+            self._members = members
+            return True
+
+    def advance(self, members: Sequence[str]) -> int:
+        """Coordinator-side: bump the epoch with a new member set and
+        return the new epoch (the announcement payload)."""
+        with self._lock:
+            self._epoch += 1
+            self._members = tuple(sorted(members))
+            logger.info(
+                "roster advanced to epoch %d: %s",
+                self._epoch, self._members,
+            )
+            return self._epoch
+
+    # -- graceful departure (fed.leave) -----------------------------------
+
+    def request_leave(self) -> None:
+        """Mark this party as wanting out; the quorum round driver picks
+        the flag up at the next round boundary (``fed.leave``)."""
+        with self._lock:
+            self._leave_requested = True
+
+    def consume_leave_request(self) -> bool:
+        with self._lock:
+            requested, self._leave_requested = self._leave_requested, False
+            return requested
+
+
+# Rendezvous-key prefix of roster membership REQUESTS (join / leave):
+# routed around the mailbox via a server observer into the manager's
+# membership inbox, which the quorum coordinator drains at round
+# boundaries.  Join WELCOMES ride ordinary rendezvous keys (the joiner
+# parks a recv on them).
+ROSTER_REQ_PREFIX = "roster.req."
 
 
 def ring_neighbors(parties: Sequence[str], party: str) -> tuple:
@@ -127,6 +257,18 @@ class TransportManager:
         # health monitor can fail chunk-sink waits (which never park in
         # the mailbox) when their source party dies.  Loop thread only.
         self._stream_srcs: Dict[tuple, str] = {}
+        # Elastic membership: the live roster (epoch + members) plus the
+        # membership-request inbox (join/leave control messages from
+        # peers, consumed by a server observer; the quorum coordinator
+        # drains it at round boundaries).  deque append/popleft are
+        # atomic, so the loop thread appends and driver threads drain
+        # without a lock.
+        import collections as _collections
+
+        self.roster = RosterState(cluster_config.parties)
+        self._membership_inbox: "_collections.deque" = _collections.deque()
+        self._server.epoch_provider = lambda: self.roster.epoch
+        self._server._observers.append(self._observe_membership)
         # Set by api.init: () -> Optional[jax.sharding.Mesh].  Received
         # shard-encoded leaves whose sender sharding fits this mesh are
         # device_put with the equivalent local NamedSharding.
@@ -188,8 +330,9 @@ class TransportManager:
         """
         from rayfed_tpu.exceptions import RemoteError
 
-        interval = self._job.peer_health_interval_s
-        threshold = max(1, int(self._job.peer_death_pings))
+        base_interval = self._job.peer_health_interval_s
+        default_pings = max(1, int(self._job.peer_death_pings))
+        tick = base_interval
         fails: Dict[str, int] = {}
         # Fail-fast covers connection LOSS, not never-connected: a party
         # only becomes eligible after evidence of reachability — a
@@ -207,19 +350,24 @@ class TransportManager:
         # transfer's eventual completion would be dropped as a dup).
         rx_prev: Dict[str, int] = {}
 
-        async def probe(party: str) -> bool:
+        async def probe(party: str, hb_s: float) -> bool:
+            # The ping deadline follows the PARTY'S OWN heartbeat, not
+            # the shared tick: one party configuring an aggressive
+            # heartbeat shrinks the probe cadence for everyone, but it
+            # must not shrink everyone's ping timeout — a healthy
+            # slow-RTT peer would read as dead.
             try:
                 return await asyncio.wait_for(
                     self._get_client(party).ping(
-                        timeout_s=min(1.0, interval), ctl=True
+                        timeout_s=min(1.0, hb_s), ctl=True
                     ),
-                    timeout=interval,
+                    timeout=max(tick, min(1.0, hb_s)),
                 )
             except Exception:
                 return False
 
         while True:
-            await asyncio.sleep(interval)
+            await asyncio.sleep(tick)
             parties = sorted(
                 self._mailbox.parties_with_waiters()
                 | self._mailbox.dead_parties()
@@ -228,6 +376,25 @@ class TransportManager:
                 # too, or a peer dying mid reduce-scatter would leave
                 # the aggregator blind until the recv backstop.
                 | self._stream_sink_parties()
+            )
+            # Per-party health knobs (heartbeat_interval_s /
+            # death_deadline_s transport options): the loop ticks at the
+            # FASTEST configured heartbeat among the monitored parties,
+            # and each party's death threshold is its own deadline
+            # expressed in ticks — defaults reproduce the job-level
+            # peer_health_interval_s × peer_death_pings behavior bit for
+            # bit.  The tick adapts one cycle late, which is fine: the
+            # deadline is what operators reason about.
+            knobs: Dict[str, tuple] = {}
+            for p in parties:
+                try:
+                    knobs[p] = self._party_health_knobs(p)
+                except Exception:
+                    knobs[p] = (
+                        base_interval, base_interval * default_pings
+                    )
+            tick = min(
+                [base_interval] + [hb for hb, _ in knobs.values()]
             )
             # Consecutive means consecutive: a party that left the
             # monitored set (its recvs resolved) starts from zero next
@@ -238,7 +405,9 @@ class TransportManager:
             ever_reachable |= self._peers_acked
             # Concurrent probes: one unreachable party must not delay
             # (and thereby slow detection for) the others.
-            results = await asyncio.gather(*(probe(p) for p in parties))
+            results = await asyncio.gather(
+                *(probe(p, knobs[p][0]) for p in parties)
+            )
             rx_now = self._server.receive_progress()
             for party, ok in zip(parties, results):
                 # Fresh arriving bytes are liveness regardless of the
@@ -249,7 +418,7 @@ class TransportManager:
                     ok = True
                 if not ok and self._mailbox.seconds_since_delivery(
                     party
-                ) <= interval:
+                ) <= tick:
                     ok = True
                 if ok:
                     ever_reachable.add(party)
@@ -265,18 +434,22 @@ class TransportManager:
                     and party not in self._mailbox.dead_parties()
                 ):
                     fails[party] = fails.get(party, 0) + 1
+                    deadline_s = knobs[party][1]
+                    threshold = max(1, int(round(deadline_s / tick)))
                     if fails[party] >= threshold:
                         logger.warning(
                             "[%s] party %s unreachable (%d consecutive "
-                            "pings); failing its pending recvs",
-                            self._party, party, fails[party],
+                            "pings, death deadline %.1fs); failing its "
+                            "pending recvs",
+                            self._party, party, fails[party], deadline_s,
                         )
                         err = RemoteError(
                             party,
                             "ConnectionError",
                             f"party {party!r} is unreachable "
                             f"({fails[party]} consecutive health pings "
-                            f"failed over ~{fails[party] * interval:.0f}s); "
+                            f"failed over ~{fails[party] * tick:.0f}s, "
+                            f"death deadline {deadline_s:.1f}s); "
                             f"its pending sends will never arrive",
                         ).to_wire()
                         self._mailbox.fail_party(party, err)
@@ -361,6 +534,15 @@ class TransportManager:
             # ride different sockets (no head-of-line blocking), and a
             # single striped payload fans its chunks across all of them.
             "connections_per_peer": 2,
+            # Health-monitor knobs (peer-death fail-fast), surfaced as
+            # validated per-party options instead of module constants:
+            # probe cadence and how long a party may stay unreachable
+            # before its pending recvs are failed.
+            "heartbeat_interval_s": self._job.peer_health_interval_s,
+            "death_deadline_s": (
+                self._job.peer_health_interval_s
+                * max(1, int(self._job.peer_death_pings))
+            ),
         }
         party_opts = dict(self._cluster.party_config(dest_party).transport_options)
         # Accept reference-style gRPC channel-arg keys for drop-in compat.
@@ -397,7 +579,31 @@ class TransportManager:
                 sorted(_KNOWN_TRANSPORT_OPTIONS),
                 sorted(_COMPAT_TRANSPORT_OPTIONS),
             )
+        opts["heartbeat_interval_s"] = float(opts["heartbeat_interval_s"])
+        opts["death_deadline_s"] = float(opts["death_deadline_s"])
+        _validate_health_knobs(
+            opts["heartbeat_interval_s"], opts["death_deadline_s"]
+        )
         return opts
+
+    def _party_health_knobs(self, dest_party: str) -> tuple:
+        """``(heartbeat_interval_s, death_deadline_s)`` for one party —
+        the per-party transport options with job-config defaults,
+        validated.  Light-weight twin of :meth:`_merged_options` for the
+        health monitor's per-cycle reads (no ignored-key bookkeeping)."""
+        opts = self._cluster.party_config(dest_party).transport_options
+        hb = float(
+            opts.get("heartbeat_interval_s",
+                     self._job.peer_health_interval_s)
+        )
+        dd = float(
+            opts.get(
+                "death_deadline_s",
+                hb * max(1, int(self._job.peer_death_pings)),
+            )
+        )
+        _validate_health_knobs(hb, dd)
+        return hb, dd
 
     def effective_transport_options(self, dest_party: str) -> Dict[str, Any]:
         """The merged options a client to ``dest_party`` actually runs
@@ -491,6 +697,15 @@ class TransportManager:
                     # Rails a striped payload fans over; None = host-
                     # adaptive (striping off on few-core hosts).
                     stripe_rails=opts.get("stripe_rails"),
+                    # Known-dead fast-fail: the retry ladder consults
+                    # the health monitor's dead set (thread-safe
+                    # snapshot) and skips the backoff ladder against a
+                    # destination already declared dead — one attempt,
+                    # no 65s of retries against a corpse.
+                    dead_check=(
+                        lambda p=dest_party:
+                        p in self._mailbox.dead_parties_snapshot()
+                    ),
                 )
                 self._clients[dest_party] = client
             return client
@@ -559,6 +774,7 @@ class TransportManager:
         downstream_seq_id: Any,
         stream: Optional[str] = None,
         round_tag: Optional[int] = None,
+        epoch_tag: Optional[int] = None,
     ) -> LocalRef:
         """Owner-initiated push.  Returns a LocalRef resolving to True/False.
 
@@ -577,10 +793,15 @@ class TransportManager:
         round's frames are still in flight while the next computes, and
         the tag is what keeps receiver logs and the overlap runner's
         same-round fallback attributable to the round that owns them.
+
+        ``epoch_tag``: roster epoch stamped into the frame metadata
+        (``wire.EPOCH_TAG_KEY``) — a receiver whose roster has advanced
+        rejects the frame loudly instead of parking stale bytes (see
+        :class:`RosterState`).
         """
         return self.send_many(
             [dest_party], data, upstream_seq_id, downstream_seq_id,
-            stream=stream, round_tag=round_tag,
+            stream=stream, round_tag=round_tag, epoch_tag=epoch_tag,
         )[dest_party]
 
     def send_many(
@@ -591,6 +812,7 @@ class TransportManager:
         downstream_seq_id: Any,
         stream: Optional[str] = None,
         round_tag: Optional[int] = None,
+        epoch_tag: Optional[int] = None,
     ) -> Dict[str, LocalRef]:
         """Fan one value out to N parties — encode once, send concurrently.
 
@@ -608,10 +830,12 @@ class TransportManager:
         dests = list(dest_parties)
         out_refs: Dict[str, LocalRef] = {p: LocalRef() for p in dests}
         self.stats["send_op_count"] += len(dests)
-        send_meta = (
-            None if round_tag is None
-            else {wire.ROUND_TAG_KEY: str(round_tag)}
-        )
+        send_meta: Optional[Dict[str, str]] = {}
+        if round_tag is not None:
+            send_meta[wire.ROUND_TAG_KEY] = str(round_tag)
+        if epoch_tag is not None:
+            send_meta[wire.EPOCH_TAG_KEY] = str(epoch_tag)
+        send_meta = send_meta or None
 
         def _poison_all(exc: BaseException) -> None:
             for p in dests:
@@ -894,6 +1118,51 @@ class TransportManager:
             self._stream_srcs.pop(key, None)
 
         self._loop.call_soon_threadsafe(_on_loop)
+
+    # -- elastic membership (roster control plane) ----------------------------
+
+    def _observe_membership(self, message) -> bool:
+        """Server observer (loop thread): membership requests — keys
+        prefixed :data:`ROSTER_REQ_PREFIX` — go to the inbox, not the
+        mailbox (the coordinator polls the inbox at round boundaries;
+        a mailbox rendezvous would need the recv side to know the
+        sender's nonce in advance)."""
+        if not str(message.upstream_seq_id).startswith(ROSTER_REQ_PREFIX):
+            return False
+        if message.error is not None:
+            return True  # a poisoned control key carries nothing to act on
+        self._membership_inbox.append(message)
+        return True
+
+    def drain_membership_requests(self) -> list:
+        """Decoded membership requests received since the last drain —
+        each a dict like ``{"op": "join"|"leave", "party": ..., "nonce":
+        ...}``.  Any thread; arrival order preserved."""
+        out = []
+        while True:
+            try:
+                msg = self._membership_inbox.popleft()
+            except IndexError:
+                break
+            try:
+                req = wire.decode_payload(
+                    msg.payload,
+                    allowed=self._cluster.serializing_allowed_list,
+                    device_put=False,
+                )
+                if isinstance(req, dict):
+                    out.append(req)
+                else:
+                    logger.warning(
+                        "[%s] malformed membership request from %s: %r",
+                        self._party, msg.src_party, type(req).__name__,
+                    )
+            except Exception:
+                logger.exception(
+                    "[%s] failed to decode membership request from %s",
+                    self._party, msg.src_party,
+                )
+        return out
 
     def ring_neighbors(
         self, parties: Optional[Sequence[str]] = None,

@@ -195,8 +195,14 @@ class Mailbox:
             # raced in concurrently has set the event and is returned.
             if entry.message is None:
                 self._entries.pop(key, None)
-                raise TimeoutError(
-                    f"recv of ({key[0]}, {key[1]}) timed out after {timeout_s}s"
+                from rayfed_tpu.exceptions import PartyWaitTimeout
+
+                raise PartyWaitTimeout(
+                    f"recv of ({key[0]}, {key[1]}) timed out after "
+                    f"{timeout_s}s",
+                    missing_parties=(
+                        [entry.expected_src] if entry.expected_src else []
+                    ),
                 ) from None
         # Pop: a rendezvous key is consumed exactly once (ref barriers.py:338-340).
         self._entries.pop(key, None)

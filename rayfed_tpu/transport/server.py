@@ -474,6 +474,24 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             self._abort()
             return
 
+        from rayfed_tpu import chaos
+
+        if chaos.installed() is not None:
+            try:
+                chaos.fire(
+                    "server_frame", party=server._party,
+                    src=header.get("src"), up=str(header.get("up")),
+                    down=str(header.get("down")),
+                )
+            except chaos.ChaosFault:
+                # Injected receive-side drop: discard the frame WITHOUT
+                # an ACK — the sender's deadline/retry machinery is what
+                # this fault exists to exercise.  A sink that saw the
+                # payload's bytes hears a clean abort, like a died
+                # connection.
+                self._notify_sink_abort(header, corrupt=False)
+                return
+
         if header.get("ccrc") is not None:
             # Stream frame (wire v3): per-chunk CRCs verified as the
             # integrity check — the whole-payload _crc_of re-check is
@@ -750,6 +768,45 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 },
             )
             return
+        ep = (header.get("meta") or {}).get(wire.EPOCH_TAG_KEY)
+        if ep is not None and server.epoch_provider is not None:
+            cur = server.epoch_provider()
+            if cur is not None and int(ep) < int(cur):
+                # STALE-epoch frame (elastic membership): the sender's
+                # roster lags this party's — reject LOUDLY and fatally
+                # (a retry can't fix a stale epoch; the late
+                # contribution folds into the next round via the
+                # sender's own DGA correction instead).  Frames from a
+                # NEWER epoch are accepted: a straggler a full round
+                # behind still has the old epoch when the advanced
+                # coordinator's broadcast lands, and that broadcast is
+                # the very frame carrying the roster transition it
+                # needs — gating it would strand every straggler.
+                server.stats["receive_epoch_rejects"] = (
+                    server.stats.get("receive_epoch_rejects", 0) + 1
+                )
+                logger.warning(
+                    "[%s] rejecting frame (%s, %s) from %s: roster epoch "
+                    "%s, this party is at epoch %s",
+                    server._party, header.get("up"), header.get("down"),
+                    header.get("src"), ep, cur,
+                )
+                self._notify_sink_abort(header, corrupt=False)
+                self._reply(
+                    wire.MSG_ERR,
+                    {
+                        "rid": header.get("rid"),
+                        "fatal": True,
+                        "code": "epoch",
+                        "error": (
+                            f"stale roster epoch: frame carries epoch "
+                            f"{ep}, party {server._party!r} is at epoch "
+                            f"{cur} — the membership advanced; fold the "
+                            f"late contribution into the next round"
+                        ),
+                    },
+                )
+                return
         message = Message(
             src_party=header.get("src", "?"),
             upstream_seq_id=str(header.get("up")),
@@ -762,6 +819,24 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         server.stats["receive_op_count"] += 1
         server.stats["receive_bytes"] += len(payload)
         key = (message.upstream_seq_id, message.downstream_seq_id)
+        for obs in list(server._observers):
+            try:
+                if obs(message):
+                    # Consumed by a control-plane observer (e.g. a
+                    # roster membership request): never enters the
+                    # mailbox, but the rendezvous is still remembered
+                    # (sender retries dedupe) and the delivery counts
+                    # as liveness.
+                    server._mailbox.mark_delivered(message.src_party, key)
+                    self._reply(
+                        wire.MSG_ACK,
+                        {"rid": header.get("rid"), "result": "OK"},
+                    )
+                    return
+            except Exception:  # pragma: no cover - observer bug
+                logger.exception(
+                    "[%s] message observer failed", server._party
+                )
         sink = server.take_chunk_sink(key)
         if sink is not None:
             # Sink-consumed delivery: the payload never parks in the
@@ -1093,6 +1168,17 @@ class TransportServer:
         self._ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._on_message = on_message
+        # Consuming observers (loop thread): each is called with every
+        # delivered DATA message BEFORE the mailbox; returning True
+        # consumes it (no mailbox entry, still ACKed + liveness-
+        # credited).  The control-plane demux the roster membership
+        # inbox rides on — unlike _on_message (the multi-host leader's
+        # republish tap), observers may be stacked.
+        self._observers: list = []
+        # Elastic membership: () -> Optional[int], the receiver's
+        # current roster epoch.  Frames stamped with a different epoch
+        # (wire.EPOCH_TAG_KEY) are rejected loudly.  Set by the manager.
+        self.epoch_provider: Optional[Callable[[], Optional[int]]] = None
         self._warned_no_native_crc = False
         self.stats: Dict[str, Any] = {"receive_op_count": 0, "receive_bytes": 0}
         # Per-party monotonically growing byte counters INCLUDING bytes

@@ -121,6 +121,21 @@ STRIPE_MIN_BYTES = 8 * 1024 * 1024
 # cross-party contract — fingerprinted by tool/check_wire_format.py.
 ROUND_TAG_KEY = "rnd"
 
+# Metadata key carrying the sender's ROSTER EPOCH (elastic membership):
+# quorum-round frames are stamped with the epoch their sender's roster
+# was at, and a receiver whose roster has advanced PAST the frame's
+# epoch rejects it loudly (a fatal MSG_ERR naming both epochs) instead
+# of parking a stale round's bytes in the mailbox forever.  Frames from
+# a NEWER epoch are accepted — the advanced coordinator's broadcast is
+# what carries the roster transition to lagging stragglers.  Late
+# contributions are never lost by the rejection — they fold into the
+# NEXT round via the sender's own local DGA correction, not via the
+# stale wire push.  Same
+# meta-dict transport as ROUND_TAG_KEY: no frame-layout change, but the
+# key name is a cross-party contract — fingerprinted by
+# tool/check_wire_format.py.
+EPOCH_TAG_KEY = "ep"
+
 
 def pack_frame(
     msg_type: int,

@@ -32,9 +32,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # hide at least half the per-round comms wall under local compute),
 # wire_vs_push_capability >= 0.5 (the FedAvg exchange must sustain at
 # least half the same-box push capability — the r05 send-path gap was
-# 0.24) and send_vs_read_wall_ratio <= 1.5 (no full-payload
+# 0.24), send_vs_read_wall_ratio <= 1.5 (no full-payload
 # serialization barrier in front of the coordinator's broadcast; the
-# r05 send/read imbalance was 2.7x).
+# r05 send/read imbalance was 2.7x), and the CHAOS gate: under a
+# seeded schedule injecting 1 straggler past the round deadline + 1
+# hard party crash at N=4, run_fedavg_rounds(quorum=2) must complete
+# every round on every surviving controller with identical bytes, a
+# strict-subset round-1 quorum, and an advanced roster epoch (the
+# dead party dropped without any runtime restart).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

@@ -1,0 +1,183 @@
+"""distributed.py hardening: leader death fail-fast + republish poison.
+
+ROADMAP item 3 calls the multi-host bridge the thinnest-tested risky
+component.  These tests drive its two worst failure stories IN-PROCESS
+(no jax.distributed, no subprocesses — a duck-typed fake process group
+stands in for the coordination-service KV):
+
+- the party LEADER dies mid-round → every non-leader's parked bridge
+  recv raises a RemoteError naming the leader within the death
+  deadline (the member-side leader watchdog), instead of hanging until
+  the recv backstop;
+- a leader→member bridge republish fails (payload exceeds the bridge's
+  cap) → the member's recv raises a RemoteError carrying the republish
+  failure instead of hanging.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig, RetryPolicy
+from rayfed_tpu.distributed import MultiHostTransport
+from rayfed_tpu.exceptions import RemoteError
+from rayfed_tpu.transport.manager import TransportManager
+from tests.multiproc import get_free_ports
+
+
+class _FakeGroup:
+    """Duck-typed PartyProcessGroup: an in-memory KV, no jax.distributed."""
+
+    def __init__(self, num_processes, process_id, kv=None):
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self._kv = kv if kv is not None else {}
+
+    @property
+    def is_leader(self):
+        return self.process_id == 0
+
+    def publish_bridge_address(self, address):
+        self._kv[self.process_id] = address
+
+    def fetch_bridge_address(self, pid, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while pid not in self._kv:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no bridge address for p{pid}")
+            time.sleep(0.05)
+        return self._kv[pid]
+
+    def barrier(self, name, timeout_s=120.0):
+        pass
+
+    def cleanup(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+def _mk_manager(party, ports, **job_kw):
+    cc = ClusterConfig(
+        parties={
+            p: PartyConfig.from_dict({"address": f"127.0.0.1:{port}"})
+            for p, port in ports.items()
+        },
+        current_party=party,
+    )
+    job = dict(
+        device_put_received=False,
+        cross_silo_timeout_s=3,
+        retry_policy=RetryPolicy(max_attempts=2, initial_backoff_s=0.2,
+                                 max_backoff_s=0.4, jitter=False),
+    )
+    job.update(job_kw)
+    return TransportManager(cc, JobConfig(**job))
+
+
+def test_leader_death_poisons_member_recvs_within_deadline():
+    (leader_port,) = get_free_ports(1)
+    leader_mgr = _mk_manager("alice", {"alice": leader_port})
+    leader_mgr.start()
+    member = MultiHostTransport(
+        None,
+        _FakeGroup(num_processes=2, process_id=1),
+        device_put_received=False,
+        timeout_s=60.0,
+        job_config=JobConfig(
+            peer_health_interval_s=0.3,
+            peer_death_pings=2,
+            cross_silo_timeout_s=3,
+            device_put_received=False,
+        ),
+        leader_address=f"127.0.0.1:{leader_port}",
+    )
+    try:
+        # Park a recv on the bridge (what a non-leader does for every
+        # cross-party value) and let the watchdog see the leader alive.
+        ref = member.recv("bob", "u1", "d1")
+        time.sleep(1.2)
+        assert not ref.done()
+        leader_mgr.stop()  # the leader process dies mid-round
+        t0 = time.monotonic()
+        with pytest.raises(RemoteError, match="leader"):
+            ref.resolve(timeout=30)
+        assert time.monotonic() - t0 < 15
+        # New waiters keep failing while the leader stays dead.
+        with pytest.raises(RemoteError, match="leader"):
+            member.recv("bob", "u2", "d1").resolve(timeout=30)
+    finally:
+        member.stop()
+
+
+def test_republish_failure_raises_on_member_instead_of_hanging():
+    leader_port, bob_port = get_free_ports(2)
+    ports = {"alice": leader_port, "bob": bob_port}
+    kv = {}
+    # The "non-leader process": a bridge listener whose message cap is
+    # too small for the republished payload (the classic torn-config
+    # failure) — but big enough for the poison frame.
+    bridge_cc = ClusterConfig(
+        parties={"bridge-p1": PartyConfig.from_dict(
+            {"address": "0.0.0.0:0"}
+        )},
+        current_party="bridge-p1",
+    )
+    bridge_mgr = TransportManager(
+        bridge_cc,
+        JobConfig(device_put_received=False,
+                  cross_silo_messages_max_size=16 * 1024),
+    )
+    bridge_mgr.start()
+    kv[1] = f"127.0.0.1:{bridge_mgr._server.bound_port}"
+
+    inner = _mk_manager("alice", ports)  # NOT started: the leader wrapper
+    leader = MultiHostTransport(
+        inner,
+        _FakeGroup(num_processes=2, process_id=0, kv=kv),
+        device_put_received=False,
+        timeout_s=60.0,
+        job_config=inner._job,
+    )
+    failures = []
+    leader.failure_handler = lambda ref, exc: failures.append(exc)
+    bob = _mk_manager("bob", ports)
+    bob.start()
+    try:
+        # Wait for the leader's bridge clients to resolve.
+        assert leader._bridge_ready.wait(timeout=15)
+        payload = np.arange(32 * 1024, dtype=np.float64)  # 256 KB > cap
+        assert bob.send("alice", payload, "u9", "d9").resolve(timeout=30)
+        # Leader received it; the republish to the bridge is fatally
+        # oversize — the member's recv must RAISE, not hang.
+        with pytest.raises(RemoteError, match="republish"):
+            bridge_mgr.recv("bob", "u9", "d9").resolve(timeout=30)
+        deadline = time.monotonic() + 10
+        while not failures and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert failures  # the cleanup watchdog heard about it too
+    finally:
+        bob.stop()
+        leader.stop()
+        bridge_mgr.stop()
+
+
+def test_barrier_failure_is_named():
+    """PartyProcessGroup.barrier wraps the raw KV error with the barrier
+    name + process — exercised through a stub client (jax.distributed
+    is not initialized in tier-1)."""
+    from rayfed_tpu.distributed import PartyProcessGroup
+
+    group = PartyProcessGroup.__new__(PartyProcessGroup)
+    group.num_processes = 2
+    group.process_id = 1
+
+    class _C:
+        def wait_at_barrier(self, name, ms):
+            raise RuntimeError("DEADLINE_EXCEEDED")
+
+    group._client = _C()
+    with pytest.raises(RuntimeError, match="barrier 'round-3' failed"):
+        group.barrier("round-3", timeout_s=0.1)

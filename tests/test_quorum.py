@@ -1,0 +1,572 @@
+"""Quorum (k-of-n) rounds, elastic membership, and the chaos harness e2e.
+
+Unit layer: StreamingAggregator quorum cutoffs (no sockets).  Integration
+layer: multiprocess parties over the real transport — full-participation
+parity (quorum=n is byte-identical to the classic streaming path), and
+THE chaos round: a seeded schedule injects one straggler past the round
+deadline and one hard party crash at N=4; the surviving controllers must
+complete every round with the documented reweighted result, the late
+contribution must fold into the next round via dga_correct, the crashed
+party must rejoin through ``fed.join`` (roster epoch advances, no
+surviving runtime restarts), and a ``fed.leave`` departure must drop the
+leaver at a round boundary.  The survivors' results are asserted
+BIT-EXACTLY against an in-process replay of the FedAvg recurrence driven
+by the recorded per-round member log.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.multiproc import make_cluster, run_parties
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+# ---------------------------------------------------------------------------
+# Unit: StreamingAggregator quorum cutoff
+# ---------------------------------------------------------------------------
+
+
+def _packed(trees):
+    from rayfed_tpu.fl import compression as C
+
+    return [C.compress(t, packed=True) for t in trees]
+
+
+def _trees(n=3):
+    return [
+        {"w": jnp.arange(10, dtype=jnp.float32) * 0.1 + i,
+         "n": np.arange(4, dtype=np.int32) + i}
+        for i in range(n)
+    ]
+
+
+def test_quorum_all_arrived_is_byte_identical():
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    packed = _packed(_trees())
+    agg = StreamingAggregator(3, quorum=3, labels=["a", "b", "c"])
+    for i, p in enumerate(packed):
+        agg.add_local(i, p)
+    r = agg.result(timeout=30, deadline_s=30)
+    ref = packed_weighted_sum(packed, None)
+    assert np.array_equal(np.asarray(r.buf), np.asarray(ref.buf))
+    assert agg.quorum_members == [0, 1, 2]
+    assert agg.stats["quorum_excluded"] == 0
+
+
+def test_quorum_deadline_cutoff_matches_subset_reduce():
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    packed = _packed(_trees())
+    agg = StreamingAggregator(3, quorum=2, labels=["a", "b", "c"])
+    agg.add_local(0, packed[0])
+    agg.add_local(2, packed[2])
+    r = agg.result(timeout=30, deadline_s=0.3)
+    ref = packed_weighted_sum([packed[0], packed[2]], None)
+    assert np.array_equal(np.asarray(r.buf), np.asarray(ref.buf))
+    np.testing.assert_array_equal(
+        np.asarray(r.passthrough[0]), np.asarray(ref.passthrough[0])
+    )
+    assert agg.quorum_members == [0, 2]
+    assert agg.stats["quorum_excluded"] == 1
+
+
+def test_quorum_failed_stream_completes_without_deadline_burn():
+    import time
+
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    packed = _packed(_trees())
+    agg = StreamingAggregator(
+        3, quorum=2, labels=["a", "b", "c"], weights=[1.0, 2.0, 3.0]
+    )
+    agg.add_local(0, packed[0])
+    agg.add_local(2, packed[2])
+    agg._on_error(1, RuntimeError("injected death"))
+    t0 = time.monotonic()
+    r = agg.result(timeout=30, deadline_s=25)
+    assert time.monotonic() - t0 < 10  # not the 25s deadline
+    ref = packed_weighted_sum([packed[0], packed[2]], [1.0, 3.0])
+    assert np.array_equal(np.asarray(r.buf), np.asarray(ref.buf))
+
+
+def test_errored_stream_recovers_on_clean_completion():
+    """A stream that failed (corrupt mid-fold / transient death) and
+    then delivered clean bytes rejoins the fold pool: the round must
+    include all contributions, not stall the ordered chain at the
+    recovered index or cut it out (code-review finding)."""
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    packed = _packed(_trees())
+    agg = StreamingAggregator(3, quorum=2, labels=["a", "b", "c"])
+    agg.add_local(0, packed[0])
+    agg._on_error(1, RuntimeError("transient"))
+    # The sender's retry delivers the full clean payload.
+    from rayfed_tpu.transport import wire as wire_mod
+
+    payload = b"".join(
+        bytes(b.produce() if isinstance(b, wire_mod.LazyBuffer) else b)
+        for b in wire_mod.encode_payload(packed[1])
+    )
+    agg._on_complete(1, payload)
+    agg.add_local(2, packed[2])
+    r = agg.result(timeout=30, deadline_s=20)
+    ref = packed_weighted_sum(packed, None)
+    assert np.array_equal(np.asarray(r.buf), np.asarray(ref.buf))
+    assert agg.quorum_members == [0, 1, 2]
+
+
+def test_quorum_unreachable_fails_loudly():
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    packed = _packed(_trees())
+    agg = StreamingAggregator(3, quorum=3, labels=["a", "b", "c"])
+    agg.add_local(0, packed[0])
+    agg._on_error(1, RuntimeError("dead"))
+    agg._on_error(2, RuntimeError("dead too"))
+    with pytest.raises(RuntimeError, match="quorum 3/3 unreachable"):
+        agg.result(timeout=10, deadline_s=1)
+
+
+def test_transient_error_recovers_before_deadline_verdict():
+    """The unreachable verdict is deadline-gated: a stream error that
+    clears (clean retry) BEFORE the deadline must not kill a round
+    whose quorum it makes (code-review finding: the eager verdict
+    defeated the recovery path)."""
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+    from rayfed_tpu.transport import wire as wire_mod
+
+    packed = _packed(_trees())
+    agg = StreamingAggregator(3, quorum=3, labels=["a", "b", "c"])
+    agg.add_local(0, packed[0])
+    # Two failures make the quorum transiently unreachable (1 alive of
+    # a 3-quorum)...
+    agg._on_error(1, RuntimeError("transient"))
+    agg._on_error(2, RuntimeError("transient"))
+    # ...but both recover with clean retries before the deadline.
+    for i in (1, 2):
+        payload = b"".join(
+            bytes(b.produce() if isinstance(b, wire_mod.LazyBuffer) else b)
+            for b in wire_mod.encode_payload(packed[i])
+        )
+        agg._on_complete(i, payload)
+    r = agg.result(timeout=30, deadline_s=10)
+    ref = packed_weighted_sum(packed, None)
+    assert np.array_equal(np.asarray(r.buf), np.asarray(ref.buf))
+    assert agg.quorum_members == [0, 1, 2]
+
+
+def test_timeout_names_missing_parties():
+    from rayfed_tpu.exceptions import PartyWaitTimeout
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    packed = _packed(_trees(2))
+    agg = StreamingAggregator(2, labels=["alice", "bob"])
+    agg.add_local(0, packed[0])
+    with pytest.raises(PartyWaitTimeout) as ei:
+        agg.result(timeout=0.4)
+    assert ei.value.missing_parties == ["bob"]
+
+
+def test_quorum_validation():
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+
+    with pytest.raises(ValueError, match="quorum"):
+        StreamingAggregator(3, quorum=4)
+    with pytest.raises(ValueError, match="labels"):
+        StreamingAggregator(3, labels=["a"])
+    agg = StreamingAggregator(2, labels=["a", "b"])
+    with pytest.raises(ValueError, match="deadline_s needs quorum"):
+        agg.result(timeout=1, deadline_s=1)
+
+
+def test_run_fedavg_rounds_quorum_validation():
+    from rayfed_tpu.fl import run_fedavg_rounds
+    from rayfed_tpu.fl.fedopt import server_sgd
+
+    trainers = {"a": object(), "b": object()}
+    with pytest.raises(ValueError, match="quorum must be in"):
+        run_fedavg_rounds(trainers, {}, 1, quorum=3, compress_wire=True,
+                          packed_wire=True)
+    with pytest.raises(ValueError, match="compress_wire"):
+        run_fedavg_rounds(trainers, {}, 1, quorum=2)
+    with pytest.raises(ValueError, match="incompatible"):
+        run_fedavg_rounds(trainers, {}, 1, quorum=2, compress_wire=True,
+                          packed_wire=True, server_opt=server_sgd(0.1))
+    with pytest.raises(ValueError, match="round_deadline_s only"):
+        run_fedavg_rounds(trainers, {}, 1, round_deadline_s=5.0)
+    with pytest.raises(ValueError, match="join_ticket only"):
+        run_fedavg_rounds(trainers, {}, 1, join_ticket={})
+    with pytest.raises(ValueError, match="round_log only"):
+        run_fedavg_rounds(trainers, {}, 1, round_log=[])
+
+
+# ---------------------------------------------------------------------------
+# Integration: parity + the chaos round
+# ---------------------------------------------------------------------------
+
+PARTIES4 = ["alice", "bob", "carol", "dave"]
+DELTAS = {"alice": 0.25, "bob": 0.5, "carol": 1.0, "dave": 2.0}
+DIM = 8
+
+
+def _define_trainers(fed, parties):
+    import jax.numpy as jnp
+
+    @fed.remote
+    class Trainer:
+        def __init__(self, delta):
+            self._d = float(delta)
+
+        def train(self, params):
+            from rayfed_tpu.fl import compression as C
+
+            tree = C.decompress(params, jnp.float32)
+            out = {"w": tree["w"] + self._d}
+            return C.compress(out, packed=True, wire_dtype=jnp.float32)
+
+    return {p: Trainer.party(p).remote(DELTAS[p]) for p in parties}
+
+
+def _replay(round_log, start_params):
+    """The documented quorum recurrence, replayed from the member log:
+    weighted mean over each round's members (sorted-party fold order),
+    DGA late folds for active-but-excluded parties, welcome resync for
+    (re)joining parties.  Bit-exact against the transport path."""
+    import jax.numpy as jnp
+
+    from rayfed_tpu.fl import compression as C
+    from rayfed_tpu.fl.fedavg import packed_weighted_sum
+    from rayfed_tpu.fl.overlap import dga_correct
+
+    current = C.compress(start_params, packed=True, wire_dtype=jnp.float32)
+    late = {}
+    history = [current]
+    for entry in round_log:
+        active, members = entry["active"], entry["members"]
+        for p in list(late):
+            if p not in active:
+                late.pop(p)
+        inputs = {p: late.pop(p, current) for p in active}
+        ups = {}
+        for p in active:
+            tree = C.decompress(inputs[p], jnp.float32)
+            ups[p] = C.compress(
+                {"w": tree["w"] + DELTAS[p]}, packed=True,
+                wire_dtype=jnp.float32,
+            )
+        current = packed_weighted_sum(
+            [ups[p] for p in sorted(members)], None
+        )
+        for p in active:
+            if p not in members:
+                late[p] = dga_correct(current, ups[p], inputs[p])
+        history.append(current)
+    return current, history
+
+
+def _run_parity(party, cluster, outdir):
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    fed.init(address="local", cluster=cluster, party=party,
+             enable_waiting_for_other_parties_ready=True)
+    trainers = _define_trainers(fed, list(cluster))
+    params = {"w": jnp.zeros((DIM,), jnp.float32)}
+
+    classic = run_fedavg_rounds(
+        trainers, params, rounds=3, compress_wire=True, packed_wire=True,
+        streaming_agg=True, wire_dtype=jnp.float32,
+    )
+    log = []
+    quorate = run_fedavg_rounds(
+        trainers, params, rounds=3, compress_wire=True, packed_wire=True,
+        wire_dtype=jnp.float32, quorum=len(cluster),
+        round_deadline_s=30.0, round_log=log,
+    )
+    assert np.array_equal(np.asarray(classic["w"]), np.asarray(quorate["w"]))
+    assert all(sorted(e["members"]) == sorted(cluster) for e in log)
+    with open(os.path.join(outdir, f"{party}.json"), "w") as f:
+        json.dump({"final": np.asarray(quorate["w"]).tolist()}, f)
+    fed.shutdown()
+
+
+def test_quorum_full_participation_parity(tmp_path_factory):
+    outdir = str(tmp_path_factory.mktemp("quorum_parity"))
+    cluster = make_cluster(["alice", "bob"])
+    run_parties(_run_parity, ["alice", "bob"], args=(cluster, outdir))
+    finals = []
+    for p in ("alice", "bob"):
+        with open(os.path.join(outdir, f"{p}.json")) as f:
+            finals.append(json.load(f)["final"])
+    assert finals[0] == finals[1]
+
+
+def _run_coord_leave(party, cluster, outdir):
+    import time
+
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import run_fedavg_rounds
+    from rayfed_tpu.fl.quorum import QuorumRoundError
+
+    fed.init(address="local", cluster=cluster, party=party,
+             enable_waiting_for_other_parties_ready=True,
+             recv_backstop_in_seconds=120)
+    trainers = _define_trainers(fed, list(cluster))
+    if party == "alice":  # the coordinator
+        fed.leave()
+    t0 = time.monotonic()
+    with pytest.raises(QuorumRoundError):
+        # The coordinator cannot leave (handover unsupported): it must
+        # raise loudly — and POISON the round broadcast so the peer's
+        # controller raises within a round trip, not at its backstop.
+        run_fedavg_rounds(
+            trainers, {"w": jnp.zeros((DIM,), jnp.float32)}, rounds=3,
+            compress_wire=True, packed_wire=True,
+            wire_dtype=jnp.float32, quorum=2, round_deadline_s=20.0,
+        )
+    assert time.monotonic() - t0 < 60  # nowhere near the 120s backstop
+    fed.shutdown()
+
+
+def test_coordinator_leave_raises_and_poisons_peers(tmp_path_factory):
+    outdir = str(tmp_path_factory.mktemp("coord_leave"))
+    cluster = make_cluster(["alice", "bob"])
+    run_parties(_run_coord_leave, ["alice", "bob"], args=(cluster, outdir))
+
+
+CHAOS_ROUNDS = 10
+CHAOS_QUORUM = 2
+CHAOS_DEADLINE_S = 3.0
+
+
+def _warm_jits(params):
+    """Compile every jitted program the round loop touches BEFORE the
+    clock starts: the first quorum deadline must measure the protocol,
+    not XLA compile times under 4-process contention."""
+    import jax
+    import jax.numpy as jnp
+
+    from rayfed_tpu.fl import compression as C
+    from rayfed_tpu.fl.fedavg import (
+        finalize_packed_stripe,
+        packed_weighted_sum,
+    )
+    from rayfed_tpu.fl.overlap import dga_correct
+    from rayfed_tpu.fl.streaming import DEFAULT_CHUNK_ELEMS, _accum_kernel
+
+    packed = C.compress(params, packed=True, wire_dtype=jnp.float32)
+    tree = C.decompress(packed, jnp.float32)
+    p2 = C.compress({"w": tree["w"] + 1.0}, packed=True,
+                    wire_dtype=jnp.float32)
+    for n in (2, 3, 4):
+        packed_weighted_sum([p2] * n, None)
+    jax.block_until_ready(dga_correct(p2, p2, packed).buf)
+    kern = _accum_kernel(DEFAULT_CHUNK_ELEMS, "float32", "float32")
+    acc = jnp.zeros(DEFAULT_CHUNK_ELEMS, jnp.float32)
+    acc = kern(acc, np.zeros(DEFAULT_CHUNK_ELEMS, np.float32),
+               np.int32(0), np.float32(1.0))
+    jax.block_until_ready(
+        finalize_packed_stripe(acc, 2.0, DIM, jnp.float32)
+    )
+
+
+def _run_chaos(party, cluster, outdir):
+    import time
+
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu import chaos
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    chaos.install({
+        "seed": 7,
+        "rules": [
+            # carol straggles past the round deadline in round 1...
+            # (8s against a 3s deadline: the margin absorbs CI load, so
+            # the cutoff verdict is deterministic)
+            {"hook": "round", "party": "carol", "match": {"round": 1},
+             "op": "delay_ms", "value": 8000},
+            # ...and dave hard-crashes at the same round boundary.
+            {"hook": "round", "party": "dave", "match": {"round": 1},
+             "op": "crash_party"},
+        ],
+    })
+
+    def _init(wait_ready=True):
+        fed.init(
+            address="local", cluster=cluster, party=party,
+            enable_waiting_for_other_parties_ready=wait_ready,
+            # Tolerant DEFAULT death deadline (1s × 3 pings): a loaded
+            # but healthy coordinator must never be falsely declared
+            # dead mid-round.  The party that actually crashes (dave)
+            # carries aggressive per-party knobs in the cluster config
+            # instead — exercising the heartbeat_interval_s /
+            # death_deadline_s transport options end to end.
+            peer_health_interval_in_seconds=1.0,
+            peer_death_pings=3,
+            cross_silo_timeout_in_seconds=15,
+            cross_silo_retry_policy={
+                "maxAttempts": 2, "initialBackoff": "0.2s",
+                "maxBackoff": "0.5s",
+            },
+            recv_backstop_in_seconds=120,
+        )
+
+    params = {"w": jnp.zeros((DIM,), jnp.float32)}
+    _warm_jits(params)
+    _init()
+    trainers = _define_trainers(fed, PARTIES4)
+    log: list = []
+    left_early = False
+    drop_marker = os.path.join(outdir, "dave_dropped.marker")
+
+    def _on_round(r, _p):
+        # carol leaves gracefully late in the run (round boundary after
+        # round 6) — exercising fed.leave on top of the crash/rejoin.
+        if party == "carol" and r == 6:
+            fed.leave()
+        # alice signals (via the shared tmpdir) that the crashed party
+        # has been dropped — the test's deterministic rejoin trigger.
+        if party == "alice" and r == 2:
+            with open(drop_marker, "w") as f:
+                f.write("dropped")
+
+    kwargs = dict(
+        rounds=CHAOS_ROUNDS, compress_wire=True, packed_wire=True,
+        wire_dtype=jnp.float32, quorum=CHAOS_QUORUM,
+        round_deadline_s=CHAOS_DEADLINE_S, round_log=log,
+        on_round=_on_round, coordinator="alice",
+    )
+    try:
+        final = run_fedavg_rounds(trainers, params, **kwargs)
+    except chaos.ChaosPartyCrash:
+        # Hard-crash simulation: the transport dies abruptly (peers see
+        # EOF and failed pings, exactly like a SIGKILL), then the party
+        # comes back as a FRESH runtime and rejoins the in-progress run.
+        from rayfed_tpu.runtime import get_runtime, set_current_runtime
+
+        rt = get_runtime()
+        rt.transport.stop()
+        rt.executor.shutdown(wait=False)
+        set_current_runtime(None)
+        deadline = time.monotonic() + 90
+        while not os.path.exists(drop_marker):
+            if time.monotonic() > deadline:
+                raise AssertionError("never saw the dropped marker")
+            time.sleep(0.2)
+        # Rejoin: fresh runtime on the same address; no all-party ready
+        # ping (the roster may legitimately be smaller now).
+        _init(wait_ready=False)
+        ticket = fed.join(coordinator="alice", timeout=120)
+        assert ticket["epoch"] >= 2, ticket  # drop (+1) then rejoin (+1)
+        trainers = _define_trainers(fed, PARTIES4)
+        final = run_fedavg_rounds(
+            trainers, params, join_ticket=ticket, **kwargs
+        )
+    if party == "carol":
+        left_early = len(log) < CHAOS_ROUNDS
+
+    with open(os.path.join(outdir, f"{party}.json"), "w") as f:
+        json.dump({
+            "final": np.asarray(final["w"]).tolist(),
+            "round_log": log,
+            "left_early": left_early,
+        }, f)
+    fed.shutdown()
+
+
+def test_quorum_chaos_straggler_crash_rejoin_leave(tmp_path_factory):
+    """THE acceptance round: seeded chaos (1 straggler past deadline +
+    1 hard crash, N=4), quorum=2 — every surviving controller completes
+    every round with the reweighted result, the straggler's late
+    contribution folds into the next round via dga_correct, the crashed
+    party rejoins (roster epoch advances; no surviving runtime
+    restarts), and a fed.leave drops the leaver at a round boundary.
+    Survivor results are replayed bit-exactly from the member log."""
+    outdir = str(tmp_path_factory.mktemp("quorum_chaos"))
+    cluster = make_cluster(PARTIES4)
+    # Fast death detection ONLY for the party that will actually crash
+    # (per-party health knobs — the satellite under test); everyone
+    # else keeps the tolerant defaults.
+    cluster["dave"]["transport_options"] = {
+        "heartbeat_interval_s": 0.3, "death_deadline_s": 0.9,
+    }
+    run_parties(
+        _run_chaos, PARTIES4, args=(cluster, outdir), timeout=300,
+    )
+    reports = {}
+    for p in PARTIES4:
+        with open(os.path.join(outdir, f"{p}.json")) as f:
+            reports[p] = json.load(f)
+
+    alice = reports["alice"]
+    log = alice["round_log"]
+    assert len(log) == CHAOS_ROUNDS
+    by_round = {e["round"]: e for e in log}
+    # Round 0: clean, everyone in.
+    assert sorted(by_round[0]["members"]) == PARTIES4
+    # Round 1: the straggler and the crashed party miss the quorum but
+    # the round still completes over a strict subset (exact membership
+    # of the healthy pair is timing-dependent under CI load — the
+    # PROTOCOL assertions are: cutoff fired, the faulted parties are
+    # out, the straggler stays on the roster).
+    m1 = by_round[1]["members"]
+    assert 2 <= len(m1) < 4 and "dave" not in m1 and "carol" not in m1, log
+    assert "carol" in by_round[1]["active"]  # straggler stays a member
+    # The crashed party is dropped (dead + missed) — epoch advanced —
+    # and rejoins later: present in some later round's members.
+    assert "dave" not in by_round[2]["active"]
+    assert any("dave" in by_round[r]["members"]
+               for r in range(3, CHAOS_ROUNDS)), log
+    # carol left gracefully (leave requested after round 6): her loop
+    # ended early and the final rounds ran without her on the roster.
+    assert reports["carol"]["left_early"]
+    assert "carol" not in by_round[CHAOS_ROUNDS - 1]["active"], log
+    # Epochs advanced without any surviving runtime restarting: drop,
+    # rejoin, leave = at least 3 transitions.
+    assert by_round[CHAOS_ROUNDS - 1]["epoch"] >= 3, log
+
+    # Every controller's log agrees with alice's for the rounds it ran
+    # (the coordinator's announcements are the one truth; dave's log
+    # restarts at its rejoin round), and every full-run controller
+    # lands on identical bytes.
+    for p in ("bob", "carol", "dave"):
+        for entry in reports[p]["round_log"]:
+            assert entry == by_round[entry["round"]], (p, entry)
+    assert reports["bob"]["final"] == alice["final"]
+    assert reports["dave"]["final"] == alice["final"]
+
+    # Bit-exact replay of the documented recurrence from the member log
+    # (weighted mean over members + DGA late folds + welcome resyncs).
+    start = {"w": jnp.zeros((DIM,), jnp.float32)}
+    from rayfed_tpu.fl import compression as C
+
+    expect, history = _replay(log, start)
+    expect_w = np.asarray(C.decompress(expect)["w"], dtype=np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(alice["final"], dtype=np.float32), expect_w
+    )
+    # carol holds the model as of its last completed round.
+    carol_rounds = len(reports["carol"]["round_log"])
+    carol_expect = np.asarray(
+        C.decompress(history[carol_rounds])["w"], dtype=np.float32
+    )
+    np.testing.assert_array_equal(
+        np.asarray(reports["carol"]["final"], dtype=np.float32),
+        carol_expect,
+    )

@@ -148,6 +148,11 @@ def compute_fingerprint() -> str:
             # ordinary "meta" dict — no frame-layout change, but the key
             # name is a cross-party contract like the stream headers.
             "round_tag_key": wire.ROUND_TAG_KEY,
+            # Elastic membership: the metadata key carrying the roster
+            # epoch of quorum-round frames (cross-epoch frames are
+            # rejected loudly).  Same meta-dict transport as the round
+            # tag — no frame-layout change, but a cross-party contract.
+            "epoch_tag_key": wire.EPOCH_TAG_KEY,
             "ring_stripe_schema": _schema(stripe_manifest),
             "ring_stripe_version": ring.RING_STRIPE_VERSION,
         },
