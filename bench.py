@@ -1100,13 +1100,18 @@ def _run_chaos_party(party: str, result_q) -> None:
 
     4 parties run ``run_fedavg_rounds(quorum=2, round_deadline_s=...)``
     with a seeded chaos schedule: carol straggles 6s past the 3s round
-    deadline in round 1, and dave HARD-crashes at the same boundary
-    (``os._exit`` — sockets die, no goodbyes).  The gate: every
+    deadline in round 1, dave HARD-crashes at the same boundary
+    (``os._exit`` — sockets die, no goodbyes), and the COORDINATOR
+    (alice) hard-crashes mid-round 2, between its quorum cutoff and the
+    result broadcast — the nastiest failover window.  The gate: every
     SURVIVING controller completes all rounds, agrees on the final
-    bytes, round 1 aggregated a strict quorum subset, and the roster
-    epoch advanced (the dead party was dropped without any runtime
-    restart).  This is the failure story the quorum/membership/chaos
-    machinery exists for, exercised over real sockets on every CI run.
+    bytes, round 1 aggregated a strict quorum subset, the roster epoch
+    advanced at least twice (both corpses dropped without any runtime
+    restart), and every survivor performed at least one coordinator
+    failover (the round was re-established at the deterministic
+    successor).  This is the failure story the quorum/membership/
+    failover/chaos machinery exists for, exercised over real sockets on
+    every CI run.
     """
     import numpy as np
 
@@ -1114,6 +1119,7 @@ def _run_chaos_party(party: str, result_q) -> None:
     from rayfed_tpu import chaos
     from rayfed_tpu.fl import compression as fl_comp
     from rayfed_tpu.fl import run_fedavg_rounds
+    from rayfed_tpu.fl.quorum import QUORUM_STATS
 
     import jax
     import jax.numpy as jnp
@@ -1124,6 +1130,12 @@ def _run_chaos_party(party: str, result_q) -> None:
             {"hook": "round", "party": "carol", "match": {"round": 1},
              "op": "delay_ms", "value": 8000},
             {"hook": "round", "party": "dave", "match": {"round": 1},
+             "op": "crash_party"},
+            # Kill the coordinator AFTER round 2's cutoff pinned the
+            # member set but BEFORE anyone heard the result: only the
+            # survivors' health monitors + deterministic failover can
+            # finish the round (at the successor, bob).
+            {"hook": "announce", "party": "alice", "match": {"round": 2},
              "op": "crash_party"},
         ],
     })
@@ -1209,7 +1221,14 @@ def _run_chaos_party(party: str, result_q) -> None:
         ),
         "final_crc": int(np.frombuffer(buf.tobytes(), np.uint8).sum()),
         "final_head": float(buf[0]),
-        "epoch": int(log[-1]["epoch"]),
+        # The FINAL roster epoch (log entries carry round-START epochs,
+        # which lag the last round's own announce — here the one that
+        # dropped the crashed coordinator).
+        "epoch": int(fed.runtime.get_runtime().transport.roster.epoch),
+        "coordinator_failovers": int(
+            QUORUM_STATS["coordinator_failovers"]
+        ),
+        "final_coordinator": log[-1]["coordinator"],
         "wall_s": wall,
     }
     if result_q is not None:
@@ -1234,6 +1253,16 @@ def _fill_chaos_extra(extra: dict, res: dict) -> None:
     extra["chaos_roster_epoch"] = max(
         (r["epoch"] for r in survivors.values()), default=0
     )
+    # Every survivor must have re-established the coordinator-killed
+    # round at the successor — gate on the MINIMUM so one stale
+    # controller can't hide behind the others.
+    extra["chaos_coordinator_failovers"] = min(
+        (r.get("coordinator_failovers", 0) for r in survivors.values()),
+        default=0,
+    )
+    extra["chaos_final_coordinator"] = next(
+        (r.get("final_coordinator") for r in survivors.values()), None
+    )
     extra["chaos_round_wall_s"] = round(
         max((r["wall_s"] for r in survivors.values()), default=0.0)
         / max(1, CHAOSB_ROUNDS), 2,
@@ -1241,9 +1270,11 @@ def _fill_chaos_extra(extra: dict, res: dict) -> None:
     _log(
         f"  chaos: {len(survivors)} survivors completed "
         f"{extra['chaos_rounds_completed']}/{CHAOSB_ROUNDS} rounds under "
-        f"1 straggler + 1 crash; round-1 quorum "
-        f"{extra['chaos_round1_members']}, roster epoch "
-        f"{extra['chaos_roster_epoch']}, finals "
+        f"1 straggler + 2 crashes (incl. the coordinator mid-round); "
+        f"round-1 quorum {extra['chaos_round1_members']}, roster epoch "
+        f"{extra['chaos_roster_epoch']}, "
+        f"{extra['chaos_coordinator_failovers']} failovers (lease now at "
+        f"{extra['chaos_final_coordinator']}), finals "
         f"{'IDENTICAL' if extra['chaos_final_consistent'] else 'DIVERGED'}"
     )
 
@@ -2876,7 +2907,8 @@ def main() -> None:
             _fill_send_path_extra(extra, sp)
         with _section(extra, "chaos"):
             _log("chaos smoke (quorum=2 rounds under injected straggler "
-                 "+ party crash, 4 parties)...")
+                 "+ party crash + coordinator kill mid-round, 4 "
+                 "parties)...")
             cres = _multi_party(
                 "_run_chaos_party", parties=CHAOSB_PARTIES, ndev=1,
                 timeout=420,
@@ -2946,19 +2978,23 @@ def main() -> None:
             raise SystemExit(1)
         # CI gate (test.sh): the round must SURVIVE partial failure —
         # under 1 injected straggler past the deadline + 1 hard party
-        # crash, every surviving controller completes every quorum
-        # round, they agree on the bytes, round 1 actually aggregated a
-        # strict subset (the cutoff fired), and the roster epoch
-        # advanced (the dead party was dropped, no runtime restart).
+        # crash + a coordinator kill mid-round 2, every surviving
+        # controller completes every quorum round, they agree on the
+        # bytes, round 1 actually aggregated a strict subset (the
+        # cutoff fired), the roster epoch advanced at least twice (both
+        # corpses dropped, no runtime restart), and every survivor
+        # performed >= 1 coordinator failover (the killed round was
+        # re-established at the deterministic successor).
         if (
             extra.get("chaos_rounds_completed") != CHAOSB_ROUNDS
-            or extra.get("chaos_survivors") != len(CHAOSB_PARTIES) - 1
+            or extra.get("chaos_survivors") != len(CHAOSB_PARTIES) - 2
             or not extra.get("chaos_final_consistent")
             or not (
                 2 <= len(extra.get("chaos_round1_members", []))
                 < len(CHAOSB_PARTIES)
             )
-            or extra.get("chaos_roster_epoch", 0) < 1
+            or extra.get("chaos_roster_epoch", 0) < 2
+            or extra.get("chaos_coordinator_failovers", 0) < 1
         ):
             _log(
                 f"chaos smoke gate FAILED: rounds="
@@ -2966,7 +3002,8 @@ def main() -> None:
                 f"survivors={extra.get('chaos_survivors')} "
                 f"consistent={extra.get('chaos_final_consistent')} "
                 f"round1_members={extra.get('chaos_round1_members')} "
-                f"epoch={extra.get('chaos_roster_epoch')}"
+                f"epoch={extra.get('chaos_roster_epoch')} "
+                f"failovers={extra.get('chaos_coordinator_failovers')}"
             )
             raise SystemExit(1)
         return

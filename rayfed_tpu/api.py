@@ -289,9 +289,11 @@ def join(coordinator: Optional[str] = None,
     door.  Sends a join request to the run's coordinator and parks until
     its next round boundary returns the **welcome ticket** (round index,
     session, roster epoch — applied to this runtime before returning —
-    and the current global model).  Pass the ticket to
-    ``fl.run_fedavg_rounds(..., quorum=k, join_ticket=ticket)`` to enter
-    the loop; no other party restarts anything.  See
+    the current coordinator lease holder, and the current global model).
+    Pass the ticket to ``fl.run_fedavg_rounds(..., quorum=k,
+    join_ticket=ticket)`` to enter the loop; no other party restarts
+    anything.  ``coordinator`` must name the run's CURRENT coordinator
+    (after a failover, the announced successor).  See
     :mod:`rayfed_tpu.fl.quorum`.
     """
     from rayfed_tpu.fl.quorum import join_cluster
@@ -304,8 +306,10 @@ def leave() -> None:
     boundary.  The departure is announced by the coordinator (roster
     epoch advance) and this party's ``run_fedavg_rounds`` returns the
     last broadcast model once the roster drops it — it still
-    participates in the round in flight.  See
-    :mod:`rayfed_tpu.fl.quorum`."""
+    participates in the round in flight.  When the COORDINATOR leaves,
+    it completes its in-flight round and hands the coordinator lease to
+    the announced successor (loud failure only when no successor is
+    alive).  See :mod:`rayfed_tpu.fl.quorum`."""
     from rayfed_tpu.fl.quorum import request_leave
 
     request_leave()

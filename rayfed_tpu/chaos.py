@@ -60,9 +60,17 @@ Ops:
 - ``kill_rail`` — raise ``ConnectionResetError`` (connection-open and
   per-frame sites: one rail dies, the payload-as-a-unit retry runs).
 - ``crash_party`` — raise :class:`ChaosPartyCrash`.  Only meaningful at
-  driver-level hooks (``round``): the test/bench harness turns it into a
-  hard process exit (or, in-process, an abrupt transport stop) so peers
-  see sockets die, not a graceful goodbye.
+  driver-level hooks (``round``, ``announce``): the test/bench harness
+  turns it into a hard process exit (or, in-process, an abrupt
+  transport stop) so peers see sockets die, not a graceful goodbye.
+- ``partition`` — bidirectional frame drop between the two parties
+  named by ``value: [a, b]``.  Fires at the ``wire`` hook (every
+  client-side frame incl. health pings and handshakes, and every
+  server-side received frame), so to BOTH endpoints the partner looks
+  exactly dead — pings time out, sends fail, arriving frames are
+  discarded without a reply — while both processes stay alive.  Unlike
+  the other ops a partition persists (``count`` defaults to
+  unbounded); scope it with ``after``/``count`` to heal it.
 
 Hook catalog (:data:`HOOKS`) — ``hook name: (site, context fields)``:
 
@@ -73,11 +81,24 @@ Hook catalog (:data:`HOOKS`) — ``hook name: (site, context fields)``:
 - ``frame`` — ``TransportClient._roundtrip`` before a DATA frame's bytes
   hit the socket (``dest``, ``header`` mutable): ``delay_ms``,
   ``drop_frame``, ``corrupt_crc``, ``kill_rail``.
+- ``wire`` — EVERY client-side frame (``TransportClient._roundtrip``
+  entry: data, pings, handshakes; ``dest``, ``type``) and every
+  server-side received frame (``src``, ``type``): ``partition``,
+  ``drop_frame``, ``delay_ms`` (client side only — the receive side is
+  a sync event-loop callback, so a matched delay there is logged and
+  SKIPPED rather than stalling every peer's frames).  The
+  asymmetric-connectivity hook — a rule here starves the health
+  monitor's pings too, which ``frame`` (data frames only) cannot.
 - ``server_frame`` — ``TransportServer`` dispatch of a received DATA
   frame (``src``, ``up``, ``down``): ``drop_frame`` (frame discarded
   without an ACK — the sender times out and retries).
 - ``round`` — the federated round driver at each round boundary
   (``round``): ``delay_ms`` (a straggler), ``crash_party``.
+- ``announce`` — the quorum coordinator between the round cutoff and
+  its result/announce broadcast (``round``, ``epoch``): ``delay_ms``,
+  ``crash_party``.  The nastiest failover window: the round is decided
+  but nobody has heard — killing the coordinator HERE forces the
+  successor to re-establish the round from re-pushed contributions.
 - ``republish`` — the multi-host leader's bridge republish
   (``pid``, ``up``, ``down``): ``drop_frame``, ``delay_ms``.
 """
@@ -96,9 +117,15 @@ logger = logging.getLogger(__name__)
 
 ENV_VAR = "RAYFED_CHAOS"
 
-HOOKS = ("connect", "send", "frame", "server_frame", "round", "republish")
+HOOKS = (
+    "connect", "send", "frame", "wire", "server_frame", "round",
+    "announce", "republish",
+)
 
-_OPS = ("delay_ms", "drop_frame", "corrupt_crc", "kill_rail", "crash_party")
+_OPS = (
+    "delay_ms", "drop_frame", "corrupt_crc", "kill_rail", "crash_party",
+    "partition",
+)
 
 
 class ChaosFault(ConnectionError):
@@ -137,9 +164,22 @@ class _Rule:
         self.party = spec.get("party")
         self.match = dict(spec.get("match") or {})
         self.after = int(spec.get("after", 0))
-        count = spec.get("count", 1)
+        # A partition is a standing condition, not an event — it stays
+        # up until explicitly bounded (count) or uninstalled.
+        count = spec.get("count", None if self.op == "partition" else 1)
         self.count = None if count is None else int(count)
         self.value = spec.get("value")
+        if self.op == "partition":
+            if (
+                not isinstance(self.value, (list, tuple))
+                or len(self.value) != 2
+                or len(set(map(str, self.value))) != 2
+            ):
+                raise ValueError(
+                    "partition op needs value=[party_a, party_b] naming "
+                    f"two distinct parties, got {self.value!r}"
+                )
+            self.value = [str(p) for p in self.value]
         self.seen = 0
         self.fired = 0
         # Rule-local deterministic rng (e.g. delay drawn from [lo, hi]):
@@ -149,6 +189,13 @@ class _Rule:
     def matches(self, party: Optional[str], ctx: Dict[str, Any]) -> bool:
         if self.party is not None and party != self.party:
             return False
+        if self.op == "partition":
+            # Bidirectional: the event is on the cut link iff the acting
+            # party and its wire partner (dest on the client side, src on
+            # the server side) are exactly the named pair.
+            partner = ctx.get("dest", ctx.get("src"))
+            if partner is None or {party, partner} != set(self.value):
+                return False
         for key, want in self.match.items():
             got = ctx.get(key)
             if key == "stream":
@@ -243,6 +290,16 @@ def _apply(rule: _Rule, hook: str, party: Optional[str],
         logger.warning("%s party=%s delaying %.0f ms (ctx=%s)",
                        label, party, delay * 1e3, _ctx_brief(ctx))
         return delay
+    if rule.op == "partition":
+        # A standing partition fires on every frame — log its onset, not
+        # a warning per dropped ping.
+        if rule.fired == 1:
+            logger.warning("%s party=%s up (ctx=%s)", label, party,
+                           _ctx_brief(ctx))
+        raise ChaosFault(
+            f"{label}: link between {rule.value[0]!r} and "
+            f"{rule.value[1]!r} is partitioned"
+        )
     logger.warning("%s party=%s firing (ctx=%s)", label, party,
                    _ctx_brief(ctx))
     if rule.op == "drop_frame":
@@ -282,6 +339,29 @@ def fire(hook: str, party: Optional[str] = None, **ctx: Any) -> None:
     delay = _apply(rule, hook, party, ctx)
     if delay:
         time.sleep(delay)
+
+
+def fire_nonblocking(hook: str, party: Optional[str] = None,
+                     **ctx: Any) -> None:
+    """:func:`fire` for SYNCHRONOUS event-loop callbacks that must never
+    sleep (the server's frame dispatch): drop/partition faults raise as
+    usual, but a matched ``delay_ms`` is counted, logged and SKIPPED —
+    sleeping there would stall every peer sharing the loop, injecting
+    cascading faults the schedule never specified."""
+    sched = _ACTIVE
+    if sched is None:
+        return
+    rule = sched.pick(hook, party, ctx)
+    if rule is None:
+        return
+    delay = _apply(rule, hook, party, ctx)
+    if delay:
+        logger.warning(
+            "chaos[%s:delay_ms] party=%s matched a non-blocking hook "
+            "site — the delay is SKIPPED (this site runs on the "
+            "receiver's event loop; inject delays on the sender side "
+            "instead)", hook, party,
+        )
 
 
 async def fire_async(hook: str, party: Optional[str] = None,

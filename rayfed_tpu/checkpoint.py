@@ -179,6 +179,21 @@ class FedCheckpointer:
             state = jax.tree_util.tree_unflatten(t_def, leaves)
         return round_num, state
 
+    def load_metadata(self, round_num: Optional[int] = None) -> dict:
+        """The ``meta.json`` of one round's snapshot (latest by
+        default): the ``metadata=`` dict passed to :meth:`save` plus the
+        ``round``/``party`` stamps.  Quorum runs store their roster
+        epoch, member set, per-round member log and rendezvous session
+        here — everything a deterministic resume needs beyond the
+        params pytree."""
+        self._recover()
+        if round_num is None:
+            round_num = self.latest_round()
+            if round_num is None:
+                raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        with open(os.path.join(self._round_dir(round_num), "meta.json")) as f:
+            return json.load(f)
+
     def _gc(self) -> None:
         rounds = self.rounds()
         for stale in rounds[: -self._max_to_keep]:

@@ -49,11 +49,30 @@ session id itself is drawn once per run from the ordinary seq stream
 (identical on every non-joining controller) and handed to joiners in
 the welcome.
 
-Coordinator caveat: the quorum round's coordinator is a single point of
-failure for the round (the ring mode spreads the *bytes*, but its
-fallback and the membership announcements still anchor at the
-coordinator).  Surviving coordinator death is future work; pick a
-reliable party via ``run_fedavg_rounds(coordinator=...)``.
+- **Coordinator failover**: the coordinator role is a rotating,
+  crash-tolerant lease, not a pinned single point of failure.  When a
+  controller's health monitor declares the coordinator dead mid-round,
+  it derives the **successor** locally — the next alive party after the
+  coordinator on the sorted roster ring
+  (:func:`rayfed_tpu.transport.manager.roster_successor`; no election,
+  no new consensus) — and **re-establishes the same round** there:
+  every survivor re-pushes its retained round-*r* contribution to the
+  successor (fresh rendezvous keys derived from the successor-scoped
+  stream name), the successor runs the same deadline-gated cutoff and
+  refold, and the result stays bit-identical to ``packed_weighted_sum``
+  over the arrived member set.  The successor's first announcement
+  drops the dead coordinator (epoch advance), so a crash costs at most
+  one round of extra latency and zero divergence.  A coordinator
+  ``fed.leave()`` is gentler still: it completes the in-flight round,
+  and its announcement **names the successor** (a graceful handover) —
+  the loud failure remains only when no successor is alive.
+
+- **Checkpointable**: with a ``checkpointer`` each party snapshots
+  ``(round, roster epoch, member log, session, params)`` at round
+  boundaries; a fully-crashed cluster resumes the quorum run
+  deterministically, re-deriving the coordinator from the restored
+  roster (a resumed party that was mid-failover lands on the same
+  successor every other party derives).
 """
 
 from __future__ import annotations
@@ -67,10 +86,17 @@ from rayfed_tpu import chaos
 
 logger = logging.getLogger(__name__)
 
+# Cumulative per-process counters of the coordinator-lease transitions
+# this controller observed (the bench/CI failover gate reads them):
+# ``coordinator_failovers`` — crash-driven successions this controller
+# re-established a round through; ``graceful_handovers`` — announced
+# coordinator ``fed.leave()`` handovers applied.
+QUORUM_STATS = {"coordinator_failovers": 0, "graceful_handovers": 0}
+
 
 class QuorumRoundError(RuntimeError):
     """A quorum round failed on this controller (quorum unreachable,
-    coordinator death, broadcast lost)."""
+    coordinator death with no live successor, broadcast lost)."""
 
 
 class QuorumRoundOutcome:
@@ -244,6 +270,13 @@ def quorum_aggregate(
         raise QuorumRoundError(
             f"round {round_index}: quorum aggregation failed: {exc!r}"
         ) from exc
+    # The round is decided but nobody has heard: the chaos "announce"
+    # hook sits exactly here so a harness can kill the coordinator in
+    # the nastiest window (peers parked on the broadcast with no poison
+    # coming — only the health monitor + failover can save the round).
+    # Deliberately OUTSIDE the poison-protected block: an injected
+    # crash must look like a real one, not a graceful goodbye.
+    chaos.fire("announce", party=me, round=round_index, epoch=epoch)
     payload = {"d": result, "m": members, "a": announce}
     refs = runtime.send_proxy.send_many(
         others, payload, f"{down}.down", down,
@@ -268,6 +301,7 @@ def quorum_aggregate(
 
 def _coordinator_announce_fn(
     runtime, trainers: Dict[str, Any], active: List[str],
+    coordinator: str, leaving: bool = False,
 ):
     """Build the coordinator's per-round roster-transition hook.
 
@@ -277,7 +311,16 @@ def _coordinator_announce_fn(
     advances the roster epoch when the set changed.  Join requests
     always produce a welcome (a restarted party still on the roster
     needs one to resync even though the member set is unchanged).
+
+    ``leaving``: the coordinator itself requested ``fed.leave()`` — it
+    completes this round, removes itself from the roster, and the
+    announcement carries a **handover** naming the successor (the next
+    alive party on the sorted roster ring), so the peers rotate the
+    coordinator lease at the same boundary they apply the roster.  The
+    loud failure fires only when no successor is alive.
     """
+    from rayfed_tpu.transport.manager import roster_successor
+
     transport = runtime.transport
     roster = transport.roster
 
@@ -294,22 +337,37 @@ def _coordinator_announce_fn(
                 logger.warning(
                     "ignoring malformed membership request: %r", req
                 )
-        if roster.consume_leave_request():
-            raise QuorumRoundError(
-                "the quorum coordinator cannot leave the roster "
-                "(coordinator handover is not supported); run with "
-                "coordinator= pinned to a party that stays"
-            )
+        if leaving:
+            leaves.add(coordinator)
         dead = set(transport.get_stats().get("dead_parties", ()))
         # Drop only parties that BOTH missed the round and are declared
         # dead — a straggler that merely missed the cutoff stays a
         # member (its progress folds into the next round).
         dropped = (set(active) - set(members)) & dead
-        new_members = (set(active) - dropped - leaves) | set(joins)
+        established = set(active) - dropped - leaves
+        new_members = established | set(joins)
+        handover = None
+        if coordinator not in new_members:
+            # Graceful departure: the round in flight completes HERE,
+            # and the announcement names who anchors the next one.
+            # Successor candidates are the ESTABLISHED members only —
+            # a same-round joiner is not in the round loop yet and its
+            # welcome delivery is best-effort, so handing it the lease
+            # could anchor every peer at a party that never shows up.
+            handover = roster_successor(established, coordinator, dead)
+            if handover is None:
+                raise QuorumRoundError(
+                    f"coordinator {coordinator!r} is leaving the roster "
+                    f"but no live established successor remains "
+                    f"(members {sorted(new_members)}, dead "
+                    f"{sorted(dead)}) — the run cannot continue"
+                )
         announce = None
         if new_members != set(active):
             epoch = roster.advance(sorted(new_members))
             announce = {"epoch": epoch, "members": sorted(new_members)}
+            if handover is not None:
+                announce["handover"] = handover
         return announce, [(p, n) for p, n in sorted(joins.items())]
 
     return announce_fn
@@ -332,6 +390,8 @@ def run_quorum_rounds(
     stream: str = "fedavg",
     join_ticket: Optional[Dict[str, Any]] = None,
     round_log: Optional[list] = None,
+    checkpointer: Any = None,
+    checkpoint_every: int = 0,
 ) -> Any:
     """The quorum-mode round loop behind ``run_fedavg_rounds(quorum=k)``.
 
@@ -348,15 +408,32 @@ def run_quorum_rounds(
       itself off the roster returns its last broadcast (graceful
       ``fed.leave``) — a dropped-as-dead party that is in fact alive
       must ``fed.join()`` to re-enter;
+    - the coordinator is a rotating lease: a controller whose health
+      monitor declares the coordinator dead mid-round fails over to the
+      deterministic successor and **re-establishes the same round**
+      there (see the module docstring); a coordinator ``fed.leave()``
+      completes its round and hands the lease over via the announcement;
     - ``weights`` align with ``sorted(trainers)`` and are subset per
       round to the active members;
     - ``join_ticket``: the welcome returned by ``fed.join()`` — the
       (re)joining controller starts at the welcome's round from the
-      welcome's params, with the welcome's roster epoch already applied.
+      welcome's params, with the welcome's roster epoch already applied
+      and the welcome's ``coordinator`` anchoring its rounds.
     - ``round_log``: optional list receiving one ``{"round", "epoch",
-      "active", "members"}`` dict per round — the audit trail of who was
-      on the roster and who made each round's quorum (tests and the
-      chaos bench replay the exact FedAvg recurrence from it).
+      "active", "members", "coordinator"}`` dict per round — the audit
+      trail of who was on the roster, who made each round's quorum, and
+      who coordinated it (tests and the chaos bench replay the exact
+      FedAvg recurrence from it).
+    - ``checkpointer`` (+ ``checkpoint_every``): snapshot ``(round,
+      roster epoch, member log, session, params)`` at round boundaries;
+      the next call restores the latest snapshot — round index, roster
+      epoch/members, rendezvous session and the member log all come
+      back, and the coordinator is **re-derived from the restored
+      roster** (so a cluster that fully crashed mid-failover resumes on
+      the same successor everywhere).  A pending DGA late fold is NOT
+      checkpointed: a restored straggler simply resyncs from the
+      restored global model — at most one round of its local work is
+      lost, the same bound a crash already implies.
     """
     import rayfed_tpu as fed
     from rayfed_tpu.fl.compression import PackedTree, compress, decompress
@@ -386,20 +463,60 @@ def run_quorum_rounds(
             f"observer controllers are not supported with quorum= "
             f"(use the classic aggregation paths there)"
         )
-    coord = coordinator if coordinator is not None else min(trainers)
+    # The pinned anchor (coord0) vs the live lease (coord): coord0 is
+    # what every controller derives from the arguments; coord rotates
+    # via failover/handover.  The effective stream name is derived from
+    # the pair, so all controllers that agree on the lease agree on
+    # every rendezvous key WITHOUT any shared counter (see
+    # _effective_stream).
+    coord0 = coordinator if coordinator is not None else min(trainers)
+    coord = coord0
     w_map = (
         None if weights is None
         else dict(zip(all_parties, [float(w) for w in weights]))
     )
     import jax.numpy as _jnp
 
+    from rayfed_tpu.transport.manager import roster_successor
+
     wire_dt = _jnp.bfloat16 if wire_dtype is None else wire_dtype
     backstop = runtime.job_config.recv_backstop_s
+    # One shared log even when the caller passed none: the checkpoint
+    # snapshots embed it (the restored run replays the same recurrence).
+    log = round_log if round_log is not None else []
+
+    restored = None
+    if checkpointer is not None and join_ticket is None:
+        restored = _restore_quorum_snapshot(checkpointer, params, roster, log)
 
     if join_ticket is not None:
         start_round = int(join_ticket["round"])
         session = str(join_ticket["session"])
         params = join_ticket["params"]
+        # The welcome names the run's CURRENT coordinator — a rejoiner
+        # entering after a failover or handover must not anchor at the
+        # departed party.
+        coord = str(join_ticket.get("coordinator", coord))
+    elif restored is not None:
+        start_round, session, params = restored
+        if start_round >= rounds:
+            return params
+        # Re-derive the coordinator from the restored roster: a run that
+        # checkpointed after a failover/handover has the old coordinator
+        # off the roster, and every resuming controller must land on the
+        # same successor — the deterministic succession rule gives it.
+        _, members_now = roster.snapshot()
+        if coord not in members_now:
+            coord = roster_successor(members_now, coord)
+            if coord is None:
+                raise QuorumRoundError(
+                    f"restored roster {sorted(members_now)} has no live "
+                    f"successor for coordinator {coord0!r}"
+                )
+            logger.info(
+                "[%s] restored roster lacks coordinator %s; re-derived "
+                "successor %s", me, coord0, coord,
+            )
     else:
         start_round = 0
         # One id per run, drawn identically on every (non-joining)
@@ -414,6 +531,10 @@ def run_quorum_rounds(
     )
     late_inputs: Dict[str, Any] = {}
     dga = fed.remote(dga_correct)
+    # A fed.leave() stays pending until the announced roster drops us:
+    # the request is re-sent each boundary so it survives a coordinator
+    # failover in between (the old coordinator's inbox died with it).
+    leave_pending = False
 
     r = start_round
     while r < rounds:
@@ -427,7 +548,9 @@ def run_quorum_rounds(
                 "loop at round %d", me, epoch, r,
             )
             break
-        if me != coord and roster.consume_leave_request():
+        if roster.consume_leave_request():
+            leave_pending = True
+        if leave_pending and me != coord:
             # fed.leave(): tell the coordinator; we participate until
             # the announcement drops us (next boundary).  Direct
             # transport send — see quorum_aggregate on why membership
@@ -466,17 +589,61 @@ def run_quorum_rounds(
                         "local_s", time.perf_counter() - t0
                     )
                 )
-        announce_fn = (
-            _coordinator_announce_fn(runtime, trainers, active)
-            if me == coord else None
-        )
-        outcome = _aggregate_with_mode(
-            runtime, updates, w_map, session=session, round_index=r,
-            quorum=quorum, deadline_s=round_deadline_s, coordinator=coord,
-            stream=stream, epoch=epoch, mode=mode,
-            ring_chunk_elems=ring_chunk_elems, announce_fn=announce_fn,
-            backstop=backstop, active=active, timings=rec,
-        )
+        # --- the aggregation attempt loop: deterministic coordinator
+        # failover.  The happy path runs once.  When the attempt dies
+        # BECAUSE the coordinator is (locally) declared dead, every
+        # survivor derives the same successor from the sorted roster
+        # ring and re-establishes the SAME round there: fresh rendezvous
+        # keys (the successor-scoped stream), re-pushed retained
+        # contributions, the same deadline-gated cutoff — bit-identical
+        # to packed_weighted_sum over whoever arrives.
+        failed_over: set = set()
+        while True:
+            announce_fn = (
+                _coordinator_announce_fn(
+                    runtime, trainers, active, coordinator=coord,
+                    leaving=leave_pending,
+                )
+                if me == coord else None
+            )
+            try:
+                outcome = _aggregate_with_mode(
+                    runtime, updates, w_map, session=session,
+                    round_index=r, quorum=quorum,
+                    deadline_s=round_deadline_s, coordinator=coord,
+                    stream=_effective_stream(stream, coord, coord0),
+                    epoch=epoch, mode=mode,
+                    ring_chunk_elems=ring_chunk_elems,
+                    announce_fn=announce_fn, backstop=backstop,
+                    active=active, timings=rec,
+                )
+                break
+            except QuorumRoundError as exc:
+                dead = set(
+                    runtime.transport.get_stats().get("dead_parties", ())
+                )
+                if me == coord or coord not in dead:
+                    # Not a coordinator death (a quorum shortfall, a
+                    # poisoned round, our own coordination failing):
+                    # nothing a new lease could fix — fail loudly.
+                    raise
+                failed_over.add(coord)
+                successor = roster_successor(
+                    active, coord, dead | failed_over
+                )
+                if successor is None:
+                    raise QuorumRoundError(
+                        f"round {r}: coordinator {coord!r} died and no "
+                        f"live successor remains on the roster "
+                        f"{active} (dead: {sorted(dead)})"
+                    ) from exc
+                QUORUM_STATS["coordinator_failovers"] += 1
+                logger.warning(
+                    "[%s] round %d: coordinator %s declared dead (%s); "
+                    "failing over to successor %s and re-establishing "
+                    "the round", me, r, coord, exc, successor,
+                )
+                coord = successor
         avg, members = outcome.result, outcome.members
         # Stragglers fold their missed round-r progress into round r+1
         # (DGA recurrence) instead of dropping it — each correction is a
@@ -486,15 +653,27 @@ def run_quorum_rounds(
                 late_inputs[p] = dga.party(p).remote(
                     avg, updates[p], inputs[p]
                 )
-        if outcome.announce is not None and me != coord:
-            roster.apply(
-                outcome.announce["epoch"], outcome.announce["members"]
-            )
-        if round_log is not None:
-            round_log.append({
-                "round": r, "epoch": epoch, "active": list(active),
-                "members": list(members),
-            })
+        next_coord = coord
+        if outcome.announce is not None:
+            if me != coord:
+                roster.apply(
+                    outcome.announce["epoch"], outcome.announce["members"]
+                )
+            handover = outcome.announce.get("handover")
+            if handover is not None:
+                # Graceful coordinator departure: the lease rotates at
+                # this boundary to the announced successor — the very
+                # announcement that drops the leaver from the roster.
+                next_coord = str(handover)
+                QUORUM_STATS["graceful_handovers"] += 1
+                logger.info(
+                    "[%s] round %d: coordinator %s handed the lease to "
+                    "%s", me, r, coord, next_coord,
+                )
+        log.append({
+            "round": r, "epoch": epoch, "active": list(active),
+            "members": list(members), "coordinator": coord,
+        })
         current = avg
         if rec is not None:
             rec["agg_s"] = max(
@@ -506,10 +685,37 @@ def run_quorum_rounds(
         if me == coord and outcome.welcomes:
             _send_welcomes(
                 runtime, outcome.welcomes, roster, current, r + 1,
-                session, backstop,
+                session, backstop, coordinator=next_coord,
+            )
+        coord = next_coord
+        if checkpointer is not None and checkpoint_every and (
+            (r + 1) % checkpoint_every == 0
+        ):
+            ep_now, mem_now = roster.snapshot()
+            checkpointer.save(
+                r + 1, {"params": decompress(current)},
+                metadata={
+                    "quorum_session": session,
+                    "epoch": int(ep_now),
+                    "members": list(mem_now),
+                    "coordinator": coord,
+                    "member_log": [dict(e) for e in log],
+                },
             )
         r += 1
     return decompress(current)
+
+
+def _effective_stream(stream: str, coord: str, coord0: str) -> str:
+    """The round's delta-stream scope under the current coordinator
+    lease.  The pinned coordinator keeps the caller's stream name (the
+    no-fault path stays byte-for-byte what it was); a successor gets a
+    coordinator-scoped name — which makes every failover rendezvous key
+    FRESH (the original round's keys were consumed when the monitor
+    failed the parked recvs) while staying identical across controllers
+    with no negotiation, and keeps the successor's delta caches warm for
+    every later round it coordinates."""
+    return stream if coord == coord0 else f"{stream}.fo.{coord}"
 
 
 def _aggregate_with_mode(
@@ -557,12 +763,16 @@ def _aggregate_with_mode(
                 except BaseException as exc:
                     # Peers are about to park on the announce key —
                     # they must hear the coordinator-side failure (e.g.
-                    # a coordinator fed.leave) now, not at backstop.
+                    # a no-successor coordinator fed.leave) now, not at
+                    # backstop.
                     _poison_round_key(
                         runtime, [p for p in active if p != me],
                         f"{down}.ann", down, exc,
                     )
                     raise
+                chaos.fire(
+                    "announce", party=me, round=round_index, epoch=epoch
+                )
                 refs = runtime.send_proxy.send_many(
                     [p for p in active if p != me],
                     {"a": announce}, f"{down}.ann", down,
@@ -575,9 +785,19 @@ def _aggregate_with_mode(
                             round_index, p,
                         )
             else:
-                ann = recv_on_runtime(
-                    runtime, coordinator, f"{down}.ann", down
-                ).resolve(timeout=backstop)
+                try:
+                    ann = recv_on_runtime(
+                        runtime, coordinator, f"{down}.ann", down
+                    ).resolve(timeout=backstop)
+                except BaseException as exc:
+                    # Uniform failure type: a coordinator dying between
+                    # ring assembly and its announce must reach the
+                    # driver's failover arm like any other
+                    # coordinator-death, not as a bare RemoteError.
+                    raise QuorumRoundError(
+                        f"round {round_index}: announce from coordinator "
+                        f"{coordinator!r} failed: {exc!r}"
+                    ) from exc
                 announce = ann.get("a")
             return QuorumRoundOutcome(result, members, announce, welcomes)
         except RingRoundError as exc:
@@ -596,14 +816,46 @@ def _aggregate_with_mode(
     )
 
 
+def _restore_quorum_snapshot(checkpointer, params, roster, log):
+    """Resume a quorum run from its latest snapshot: returns
+    ``(start_round, session, params)`` — with the roster epoch/members
+    applied and the member log replayed into ``log`` — or ``None`` when
+    the checkpointer holds nothing yet.  The caller re-derives the
+    coordinator from the restored roster."""
+    latest = checkpointer.latest_round()
+    if latest is None:
+        return None
+    from rayfed_tpu.fl.compression import PackedTree, decompress
+
+    tmpl = decompress(params) if isinstance(params, PackedTree) else params
+    restored_round, snap = checkpointer.restore(target={"params": tmpl})
+    meta = checkpointer.load_metadata(restored_round)
+    if "quorum_session" not in meta:
+        raise QuorumRoundError(
+            f"checkpoint round {restored_round} was not written by a "
+            f"quorum run (no roster epoch / rendezvous session in its "
+            f"metadata) — a classic-loop checkpoint directory cannot "
+            f"resume a quorum run"
+        )
+    roster.apply(int(meta["epoch"]), list(meta["members"]))
+    del log[:]
+    log.extend(dict(e) for e in (meta.get("member_log") or []))
+    logger.info(
+        "resuming quorum run at round %d (roster epoch %s, members %s)",
+        restored_round, meta["epoch"], meta["members"],
+    )
+    return int(restored_round), str(meta["quorum_session"]), snap["params"]
+
+
 def _send_welcomes(runtime, welcomes, roster, current, next_round,
-                   session, backstop) -> None:
+                   session, backstop, coordinator: str) -> None:
     """Coordinator: hand each joiner everything it needs to enter the
     loop at the next round — round index, session, the current roster
-    epoch, and the current global model.  Best-effort: a joiner that
-    died again simply re-requests later.  Direct transport send —
-    see quorum_aggregate on why membership control traffic skips the
-    cleanup send-watchdog."""
+    epoch, the CURRENT coordinator (post-handover, so a rejoiner never
+    anchors at a departed party), and the current global model.
+    Best-effort: a joiner that died again simply re-requests later.
+    Direct transport send — see quorum_aggregate on why membership
+    control traffic skips the cleanup send-watchdog."""
     epoch, members = roster.snapshot()
     for party, nonce in welcomes:
         payload = {
@@ -611,6 +863,7 @@ def _send_welcomes(runtime, welcomes, roster, current, next_round,
             "session": session,
             "epoch": int(epoch),
             "members": list(members),
+            "coordinator": coordinator,
             "params": current,
         }
         ref = runtime.send_proxy.send(
@@ -630,12 +883,20 @@ def join_cluster(
 
     Sends a join request to the coordinator's membership inbox, then
     parks until the coordinator's next round boundary sends back the
-    **welcome**: ``{"round", "session", "epoch", "members", "params"}``.
-    The roster epoch from the welcome is applied to this runtime's
-    roster before returning, so epoch-tagged frames line up immediately.
-    Pass the returned ticket to ``run_fedavg_rounds(join_ticket=...)``
-    to enter the loop at the right round with the current global model —
-    no other party restarts anything.
+    **welcome**: ``{"round", "session", "epoch", "members",
+    "coordinator", "params"}``.  The roster epoch from the welcome is
+    applied to this runtime's roster before returning, so epoch-tagged
+    frames line up immediately.  Pass the returned ticket to
+    ``run_fedavg_rounds(join_ticket=...)`` to enter the loop at the
+    right round with the current global model — no other party restarts
+    anything; the ticket's ``coordinator`` re-anchors a joiner that
+    missed a failover or handover.
+
+    ``coordinator`` must name the run's CURRENT lease holder (requests
+    land in a per-party inbox only the acting coordinator drains).
+    After a failover, that is the announced successor, not the pinned
+    party — a rejoining crashed coordinator learns it from operators or
+    retries successors in sorted-ring order.
     """
     from rayfed_tpu.proxy import recv_on_runtime
     from rayfed_tpu.runtime import get_runtime
@@ -681,7 +942,11 @@ def request_leave() -> None:
     membership.  Sets the roster's leave flag; the quorum round driver
     picks it up at the next round boundary, tells the coordinator, and
     this party exits its round loop once the announced roster drops it
-    (so it still participates in the round in flight)."""
+    (so it still participates in the round in flight).  On the
+    COORDINATOR this triggers a graceful handover: it completes the
+    in-flight round and its announcement names the successor that
+    anchors the next one — only when no successor is alive does the
+    run fail loudly."""
     from rayfed_tpu.runtime import get_runtime
 
     get_runtime().transport.roster.request_leave()

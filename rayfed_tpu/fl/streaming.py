@@ -844,6 +844,16 @@ class StreamingAggregator:
                 0 if self._participating is None
                 else self._n - len(self._participating)
             ),
+            # Which sources were cut with a STANDING error (dead party,
+            # verification failure) vs merely late: a coordinator-
+            # failover re-establishment expects exactly the dead
+            # coordinator here — anything else in the list is a second
+            # fault worth an operator's eyes.
+            "quorum_failed_sources": [
+                self._labels[i]
+                for i, s in enumerate(self._streams)
+                if s.error is not None
+            ],
         }
         with self._cond:
             self._result = result

@@ -154,6 +154,10 @@ def run_fedavg_rounds(
       bandwidth-poor; keep it STABLE across a training run, because
       every delta-stream cache is keyed by destination and a moving
       coordinator re-seeds full payloads on every peer it moves to.
+      Under ``quorum=`` this names the INITIAL lease holder only:
+      coordinator death or a coordinator ``fed.leave()`` rotates the
+      lease to the deterministic successor (see
+      :mod:`rayfed_tpu.fl.quorum`).
 
     - ``overlap``: double-buffer the rounds
       (:class:`rayfed_tpu.fl.overlap.PipelinedRoundRunner`): round *k*'s
@@ -186,14 +190,22 @@ def run_fedavg_rounds(
       NEXT round via the DGA correction instead of being dropped, and
       the live roster (``fed.join``/``fed.leave``/monitor-declared
       death) advances by coordinator announcement at round boundaries —
-      see :mod:`rayfed_tpu.fl.quorum`.  Requires ``compress_wire`` +
-      ``packed_wire``; with ``quorum=len(trainers)`` and no faults the
-      result is byte-identical to the streaming path.  Composes with
-      ``mode="ring"`` (a ring abort re-aggregates the round over the
-      coordinator topology with the quorum cutoff).  Incompatible with
+      see :mod:`rayfed_tpu.fl.quorum`.  The coordinator itself is a
+      rotating crash-tolerant lease: on monitor-declared coordinator
+      death every survivor fails over to the deterministic successor
+      (next alive party on the sorted roster ring) and re-establishes
+      the same round there, and a coordinator ``fed.leave()`` hands the
+      lease over gracefully in its final announcement.  Requires
+      ``compress_wire`` + ``packed_wire``; with ``quorum=len(trainers)``
+      and no faults the result is byte-identical to the streaming path.
+      Composes with ``mode="ring"`` (a ring abort re-aggregates the
+      round over the coordinator topology with the quorum cutoff) and
+      with ``checkpointer`` (snapshots carry round, roster epoch,
+      member log, session and params; restore re-derives the
+      coordinator from the restored roster).  Incompatible with
       ``server_opt``/``aggregator``/``sample``/``error_feedback``/
-      ``overlap``/``checkpointer`` (each needs the exact fixed-roster
-      synchronous boundary).
+      ``overlap`` (each needs the exact fixed-roster synchronous
+      boundary).
     - ``round_deadline_s``: the straggler cutoff for quorum rounds (and
       the per-wait deadline of quorum-mode ring rounds).  Without it a
       quorum round only cuts over when missing parties are DECLARED
@@ -310,7 +322,6 @@ def run_fedavg_rounds(
             "sample": sample is not None and sample != len(trainers),
             "error_feedback": error_feedback,
             "overlap": overlap,
-            "checkpointer": checkpointer is not None,
         }
         bad = [k for k, v in incompat.items() if v]
         if bad:
@@ -368,7 +379,14 @@ def run_fedavg_rounds(
     state = server_opt.init(params) if server_opt is not None else None
     start_round = 0
 
-    if checkpointer is not None and checkpointer.latest_round() is not None:
+    # Quorum rounds own their resume story (roster epoch + member log +
+    # session ride the snapshot; see fl/quorum.py) — the classic
+    # params/server-state restore below would strip all of that.
+    if (
+        checkpointer is not None
+        and quorum is None
+        and checkpointer.latest_round() is not None
+    ):
         target = {"params": params}
         if state is not None:
             target["server_state"] = state
@@ -429,6 +447,8 @@ def run_fedavg_rounds(
             timings=timings,
             join_ticket=join_ticket,
             round_log=round_log,
+            checkpointer=checkpointer,
+            checkpoint_every=checkpoint_every,
         )
 
     if overlap:

@@ -515,6 +515,17 @@ class TransportClient:
         crc_trailer: bool = False, timeout_s: Optional[float] = None,
         conn: Optional[_Conn] = None,
     ) -> Dict[str, Any]:
+        if chaos.installed() is not None:
+            # Chaos "wire" hook: fires on EVERY outbound frame — data,
+            # health pings, handshakes — so a partition rule makes the
+            # destination look exactly dead to this endpoint (the "frame"
+            # hook below covers data frames only).  Raised faults are
+            # ConnectionErrors: pings report False, sends hit the retry
+            # arms, before any connection state is touched.
+            await chaos.fire_async(
+                "wire", party=self._src_party, dest=self._dest_party,
+                type=msg_type,
+            )
         if conn is None:
             conn = await self._acquire_conn()
         rid = next(self._rid)

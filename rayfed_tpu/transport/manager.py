@@ -180,6 +180,33 @@ class RosterState:
 ROSTER_REQ_PREFIX = "roster.req."
 
 
+def roster_successor(
+    members: Sequence[str], coordinator: str, dead: Sequence[str] = (),
+) -> Optional[str]:
+    """Deterministic coordinator succession: the next alive party after
+    ``coordinator`` on the sorted roster ring.
+
+    Every controller derives the successor LOCALLY from the same inputs
+    — the epoch-numbered roster members and the (departing or declared-
+    dead) coordinator — so a coordinator crash or graceful ``fed.leave``
+    needs no election protocol: walk the sorted ring starting just past
+    the coordinator's position (wrapping), return the first candidate
+    that is a member and not in ``dead``.  ``None`` when nobody else is
+    alive.  The walk starts from the coordinator's canonical position
+    whether or not it is still a member, so iterated successions (A
+    dies, then B dies) land on the same party as a one-shot derivation
+    from the pinned coordinator over the surviving roster.
+    """
+    ring = sorted(set(members) | {coordinator})
+    i = ring.index(coordinator)
+    skip = set(dead) | {coordinator}
+    candidates = set(members)
+    for p in ring[i + 1:] + ring[:i]:
+        if p in candidates and p not in skip:
+            return p
+    return None
+
+
 def ring_neighbors(parties: Sequence[str], party: str) -> tuple:
     """``(predecessor, successor)`` of ``party`` on the sorted ring.
 

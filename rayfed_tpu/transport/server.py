@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from rayfed_tpu import chaos
 from rayfed_tpu.transport import wire
 from rayfed_tpu.transport.rendezvous import Mailbox, Message
 
@@ -424,6 +425,27 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             header = dict(header, crc=trailer_crc)
         self._reset()
 
+        if chaos.installed() is not None:
+            # Chaos "wire" hook, receive side: covers EVERY frame type
+            # (handshakes and pings included), so a partition rule also
+            # starves the partner's health probes — to the sender this
+            # party reads as dead while both processes stay alive.
+            # Non-blocking variant: this is a sync protocol callback on
+            # the shared event loop, so a delay rule must never sleep
+            # here (it would stall every peer's frames, not one link's).
+            try:
+                chaos.fire_nonblocking(
+                    "wire", party=server._party, src=header.get("src"),
+                    type=msg_type,
+                )
+            except chaos.ChaosFault:
+                # Discard without any reply: no ACK, no PONG — the
+                # sender's deadline machinery is the point.  A sink that
+                # already saw payload bytes hears a clean abort.
+                if msg_type == wire.MSG_DATA:
+                    self._notify_sink_abort(header, corrupt=False)
+                return
+
         if msg_type == wire.MSG_HELLO:
             # Connection handshake (wire v4): a mixed-version pair must
             # fail HERE with a message naming both versions, not later
@@ -474,11 +496,11 @@ class _FrameProtocol(asyncio.BufferedProtocol):
             self._abort()
             return
 
-        from rayfed_tpu import chaos
-
         if chaos.installed() is not None:
             try:
-                chaos.fire(
+                # Same non-blocking discipline as the "wire" hook above:
+                # this dispatch runs on the shared event loop.
+                chaos.fire_nonblocking(
                     "server_frame", party=server._party,
                     src=header.get("src"), up=str(header.get("up")),
                     down=str(header.get("down")),

@@ -35,11 +35,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # 0.24), send_vs_read_wall_ratio <= 1.5 (no full-payload
 # serialization barrier in front of the coordinator's broadcast; the
 # r05 send/read imbalance was 2.7x), and the CHAOS gate: under a
-# seeded schedule injecting 1 straggler past the round deadline + 1
-# hard party crash at N=4, run_fedavg_rounds(quorum=2) must complete
-# every round on every surviving controller with identical bytes, a
-# strict-subset round-1 quorum, and an advanced roster epoch (the
-# dead party dropped without any runtime restart).
+# seeded schedule injecting 1 straggler past the round deadline, 1
+# hard party crash at N=4, AND a hard kill of the COORDINATOR between
+# round 2's quorum cutoff and its broadcast, run_fedavg_rounds(
+# quorum=2) must complete every round on every surviving controller
+# with identical bytes, a strict-subset round-1 quorum, a roster epoch
+# advanced >= 2 (both corpses dropped without any runtime restart),
+# and coordinator_failovers >= 1 on every survivor (the killed round
+# was re-established at the deterministic successor).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

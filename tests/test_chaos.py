@@ -32,6 +32,45 @@ def test_schedule_validation():
         chaos.ChaosSchedule({"rules": [{"hook": "nope", "op": "drop_frame"}]})
     with pytest.raises(ValueError, match="unknown chaos op"):
         chaos.ChaosSchedule({"rules": [{"hook": "send", "op": "nope"}]})
+    # A partition must name exactly two distinct parties.
+    for bad in (None, ["alice"], ["alice", "alice"], "alice"):
+        with pytest.raises(ValueError, match="partition op needs"):
+            chaos.ChaosSchedule({"rules": [
+                {"hook": "wire", "op": "partition", "value": bad},
+            ]})
+
+
+def test_partition_rule_semantics():
+    """A partition is a STANDING bidirectional cut: it matches both
+    directions of the named pair (client dest / server src), persists
+    (default count unbounded), and never touches other links."""
+    chaos.install({"rules": [
+        {"hook": "wire", "op": "partition", "value": ["alice", "bob"]},
+    ]})
+    for _ in range(3):  # persists, both directions
+        with pytest.raises(chaos.ChaosFault, match="partitioned"):
+            chaos.fire("wire", party="alice", dest="bob", type=3)
+        with pytest.raises(chaos.ChaosFault, match="partitioned"):
+            chaos.fire("wire", party="bob", src="alice", type=1)
+    # Unrelated links are untouched — including each endpoint's links
+    # to third parties (an asymmetric-connectivity cut, not a death).
+    chaos.fire("wire", party="alice", dest="carol", type=3)
+    chaos.fire("wire", party="carol", src="bob", type=3)
+    chaos.fire("wire", party="carol", dest="dave", type=3)
+
+
+def test_announce_hook_targets_the_decided_round():
+    """The announce hook fires per (party, round) context — the harness
+    can kill the coordinator between a specific round's cutoff and its
+    broadcast."""
+    chaos.install({"rules": [
+        {"hook": "announce", "party": "alice", "match": {"round": 2},
+         "op": "crash_party"},
+    ]})
+    chaos.fire("announce", party="alice", round=1, epoch=0)
+    chaos.fire("announce", party="bob", round=2, epoch=0)
+    with pytest.raises(chaos.ChaosPartyCrash):
+        chaos.fire("announce", party="alice", round=2, epoch=0)
 
 
 def test_rule_matching_party_after_count():
@@ -207,6 +246,82 @@ def test_chaos_connect_kill_rail_is_retried(manager_pair):
     })
     assert a.send("bob", b"z" * 32, "k1", "0").resolve(timeout=30)
     assert bytes(b.recv("alice", "k1", "0").resolve(timeout=30)) == b"z" * 32
+
+
+def test_partition_blocks_link_and_heals(manager_pair):
+    a, b = manager_pair
+    # Sanity: the link works before the cut.
+    assert a.send("bob", b"pre" * 8, "p0", "0").resolve(timeout=30)
+    assert bytes(b.recv("alice", "p0", "0").resolve(timeout=30)) == b"pre" * 8
+    chaos.install({"rules": [
+        {"hook": "wire", "op": "partition", "value": ["alice", "bob"]},
+    ]})
+    # Client side: every frame (pings included) dies before the socket —
+    # to alice, bob reads exactly like a dead peer.
+    assert not a.ping("bob", timeout_s=1.0)
+    t0 = time.monotonic()
+    assert not a.send("bob", b"cut" * 8, "p1", "0").resolve(timeout=30)
+    assert time.monotonic() - t0 < 15  # the tight ladder, not a hang
+    # Healing the partition restores the link (same sockets/process).
+    chaos.uninstall()
+    assert a.ping("bob", timeout_s=2.0)
+    assert a.send("bob", b"ok!" * 8, "p2", "0").resolve(timeout=30)
+    assert bytes(b.recv("alice", "p2", "0").resolve(timeout=30)) == b"ok!" * 8
+
+
+def test_partition_server_side_silent_drop(manager_pair):
+    """One-sided arming (party filter): alice's frames cross the wire
+    and are discarded by bob's server without ANY reply — the sender's
+    ACK deadline fires (deadlines are not retried), and bob's parked
+    consumers never see the bytes.  This is the receive half a real
+    partition exercises in bob's process."""
+    a, b = manager_pair
+    chaos.install({"rules": [
+        {"hook": "wire", "op": "partition", "value": ["alice", "bob"],
+         "party": "bob"},
+    ]})
+    t0 = time.monotonic()
+    assert not a.send("bob", b"drp" * 8, "sd1", "0").resolve(timeout=30)
+    assert time.monotonic() - t0 < 15
+    assert not a.ping("bob", timeout_s=1.0)  # PONG suppressed too
+    chaos.uninstall()
+    assert a.send("bob", b"yes" * 8, "sd2", "0").resolve(timeout=30)
+    assert bytes(b.recv("alice", "sd2", "0").resolve(timeout=30)) == b"yes" * 8
+
+
+def test_partition_drives_death_declaration():
+    """The failover trigger chain: a partition starves the health
+    monitor's pings, so the partitioned peer is declared dead and the
+    parked recvs fail — exactly the signal the quorum driver's
+    coordinator failover arms on, with both processes alive."""
+    pa, pb = get_free_ports(2)
+    ports = {"alice": pa, "bob": pb}
+    a = _mk_manager(
+        "alice", ports, peer_health_interval_s=0.3, peer_death_pings=2
+    )
+    b = _mk_manager("bob", ports)
+    a.start()
+    b.start()
+    try:
+        # bob proves reachable first (fail-fast only covers LOSS).
+        assert b.send("alice", b"hi", "h0", "0").resolve(timeout=10)
+        assert a.recv("bob", "h0", "0").resolve(timeout=10) is not None
+        chaos.install({"rules": [
+            {"hook": "wire", "op": "partition",
+             "value": ["alice", "bob"]},
+        ]})
+        from rayfed_tpu.exceptions import RemoteError
+
+        t0 = time.monotonic()
+        ref = a.recv("bob", "never", "0")
+        with pytest.raises(RemoteError, match="unreachable"):
+            ref.resolve(timeout=30)
+        assert time.monotonic() - t0 < 15
+        assert "bob" in a.get_stats()["dead_parties"]
+    finally:
+        chaos.uninstall()
+        a.stop()
+        b.stop()
 
 
 # ---------------------------------------------------------------------------
