@@ -23,6 +23,14 @@ Public API surface mirrors the reference (``fed/__init__.py:15-29``):
 ``FedObject``.
 """
 
+# Lock-order sanitizer (RAYFED_SANITIZE=1): must install BEFORE the
+# submodules below run — their module/instance locks are constructed at
+# import time and only locks built after install() are tracked.  No-op
+# (one env read) when the flag is unset.
+from rayfed_tpu import _sanitizer as _sanitizer
+
+_sanitizer.maybe_install_from_env()
+
 from rayfed_tpu.api import (
     init,
     shutdown,

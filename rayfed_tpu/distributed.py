@@ -413,6 +413,7 @@ class MultiHostTransport:
 
     def _on_leader_message(self, message) -> None:
         # Runs on the inner loop thread; must not block.
+        # fedlint: disable=FED002 — provably on-loop: installed as the server's _on_message callback, invoked only from its frame dispatch on the loop thread
         asyncio.ensure_future(self._republish(message))
 
     async def _republish(self, message) -> None:

@@ -93,12 +93,14 @@ class LocalRef:
         def _run(value: Any) -> None:
             try:
                 out.set_result(fn(value))
+            # fedlint: disable=FED004 — transferred, not swallowed: KI/SE resolve the chained LocalRef and re-raise at resolve()
             except BaseException as e:
                 out.set_exception(e)
 
         def _cb(ref: "LocalRef") -> None:
             try:
                 exc = ref.exception()
+            # fedlint: disable=FED004 — transferred, not swallowed: the cancellation/KI resolves the chained ref and re-raises at resolve()
             except BaseException as e:
                 # exception() on a CANCELLED future raises instead of
                 # returning (e.g. shutdown cancelling a parked recv) —
@@ -111,6 +113,7 @@ class LocalRef:
             if executor is not None:
                 try:
                     executor.submit(_run, ref.resolve())
+                # fedlint: disable=FED004 — transferred, not swallowed: a shutdown-pool submit failure resolves the chained ref
                 except BaseException as e:  # pool shut down mid-flight
                     out.set_exception(e)
             else:

@@ -164,16 +164,19 @@ def _stripe_elems(blocks: Sequence[int], chunk_elems: int, nblocks: int,
 
 
 def _check_meta(meta_json: str, want: Dict[str, Any]) -> None:
-    meta = json.loads(meta_json)
-    if meta.get("v", 0) > RING_STRIPE_VERSION:
+    # "rsm", not "meta": this is the ring stripe manifest (a payload-
+    # level contract fingerprinted via ring_stripe_schema), NOT frame
+    # metadata — fedlint FED006 polices literal keys on the latter.
+    rsm = json.loads(meta_json)
+    if rsm.get("v", 0) > RING_STRIPE_VERSION:
         raise ValueError(
-            f"stripe payload uses ring manifest v{meta.get('v')}; this "
+            f"stripe payload uses ring manifest v{rsm.get('v')}; this "
             f"party understands up to v{RING_STRIPE_VERSION}"
         )
     for key, expect in want.items():
-        if meta.get(key) != expect:
+        if rsm.get(key) != expect:
             raise ValueError(
-                f"stripe manifest mismatch: {key}={meta.get(key)!r}, "
+                f"stripe manifest mismatch: {key}={rsm.get(key)!r}, "
                 f"expected {expect!r} — ring peers disagree on the "
                 f"stripe schedule"
             )

@@ -700,6 +700,7 @@ class StreamingAggregator:
     def _run(self) -> None:
         try:
             self._run_inner()
+        # fedlint: disable=FED004 — transferred, not swallowed: fail(e) poisons every result waiter; this is the aggregator's dedicated worker thread, not the driver
         except BaseException as e:  # pragma: no cover - defensive
             logger.exception("streaming aggregator worker failed")
             self.fail(e)

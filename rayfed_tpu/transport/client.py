@@ -799,6 +799,7 @@ class TransportClient:
                 )
                 loop.call_soon_threadsafe(_resolve_ready, ready[idx], item)
                 idx += 1
+        # fedlint: disable=FED004 — transferred, not swallowed: the failure fails every pending rail future; this runs on the codec pool, not the driver
         except BaseException as e:  # fail the rails, not the executor
             for fut in ready[idx:]:
                 loop.call_soon_threadsafe(_fail_ready, fut, e)

@@ -675,6 +675,7 @@ class TransportManager:
             )
 
         async def _apply():
+            # fedlint: disable=FED001 — bounded hold: sync holders of _clients_lock only do dict ops / lazy client construction (no I/O, connections open on the loop), so this dict snapshot cannot park the loop meaningfully
             with self._clients_lock:
                 clients = dict(self._clients)
             busy = sorted(

@@ -14,6 +14,18 @@ else
   echo "ruff not installed; skipping lint"
 fi
 
+# Concurrency/aggregation contract gate (tool/fedlint): the invariants
+# PRs 1-7 paid for — no blocking calls on the event loop, loop-affine
+# calls routed threadsafe, no use-after-donate, KeyboardInterrupt/
+# SystemExit never swallowed, no seq-id allocation on the comms lane,
+# frame-metadata keys declared in wire.py, acyclic lock order — fail CI
+# here instead of deadlocking a round three PRs later.  Suppressions
+# require an inline pragma with a written reason (FED000 otherwise).
+# The dynamic half is the runtime lock-order sanitizer: tests/conftest.py
+# exports RAYFED_SANITIZE=1 so the whole pytest run (party subprocesses
+# included) raises on lock-order cycles as they form.
+python -m tool.fedlint
+
 # Codec-format drift gate: the wire manifest layout is a cross-party
 # contract — this fails unless WIRE_FORMAT_VERSION was bumped (and the
 # lock re-pinned) whenever the layout changes.

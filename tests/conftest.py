@@ -33,6 +33,14 @@ os.environ.setdefault(
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
+# Runtime lock-order sanitizer (rayfed_tpu/_sanitizer.py): every tier-1
+# test — including party subprocesses, which inherit the env — runs with
+# repo-constructed locks tracked and a LockOrderError raised the moment
+# two locks are acquired in conflicting orders.  The static FED007 pass
+# (tool/fedlint) sees only lexical nesting; this catches the dynamic,
+# callback-driven orderings.  setdefault: RAYFED_SANITIZE=0 disables.
+os.environ.setdefault("RAYFED_SANITIZE", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

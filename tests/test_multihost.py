@@ -22,10 +22,29 @@ from tests.multiproc import get_free_ports
 _UNSUPPORTED_MSG = "Multiprocess computations aren't implemented"
 
 
+def _reap(procs, timeout=10):
+    """Terminate-then-KILL every member and join it.
+
+    ``p.terminate()`` alone is NOT enough: jax.distributed installs
+    XLA's preemption notifier, which CATCHES SIGTERM ("SIGTERM caught"
+    in the logs) — a member parked in ``fed.get`` survives it, and the
+    leaked child then blocks pytest's interpreter exit forever in
+    multiprocessing's atexit join (observed as tier-1 finishing its
+    summary and never exiting).  SIGKILL is not catchable.
+    """
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+    for p in procs:
+        p.join(timeout)
+        if p.is_alive():
+            p.kill()
+            p.join(10)
+
+
 def _check_supported(procs, results):
     if any(r[0] == "unsupported" for r in results):
-        for p in procs:
-            p.terminate()
+        _reap(procs)
         pytest.skip(
             "jax CPU backend lacks multiprocess collectives on this host"
         )
@@ -214,17 +233,21 @@ def test_bulk_sharded_push_to_two_process_party():
     ]
     for p in procs:
         p.start()
-    results = _gather_results(procs, q, len(members), timeout=240)
-    _check_supported(procs, results)
-    for p in procs:
-        p.join(30)
-        if p.is_alive():
-            p.terminate()
-            raise AssertionError("member process hung")
-    assert len(results) == len(members), (
-        f"member crashed; exit codes {[p.exitcode for p in procs]}"
-    )
-    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+    try:
+        results = _gather_results(procs, q, len(members), timeout=240)
+        _check_supported(procs, results)
+        for p in procs:
+            p.join(30)
+            if p.is_alive():
+                raise AssertionError("member process hung")
+        assert len(results) == len(members), (
+            f"member crashed; exit codes {[p.exitcode for p in procs]}"
+        )
+        assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+    finally:
+        # Every exit path — skip included — must reap the members, or a
+        # straggler blocks interpreter exit in multiprocessing's atexit.
+        _reap(procs)
 
 
 CLUSTER_PORTS = get_free_ports(3)
@@ -249,15 +272,19 @@ def test_party_spanning_two_processes():
     ]
     for p in procs:
         p.start()
-    results = _gather_results(procs, q, len(members), timeout=180)
-    _check_supported(procs, results)
-    for p in procs:
-        p.join(30)
-        if p.is_alive():
-            p.terminate()
-            raise AssertionError("member process hung")
-    assert len(results) == len(members), (
-        f"member crashed; exit codes {[p.exitcode for p in procs]}"
-    )
-    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
-    assert sorted(r[2] for r in results) == pytest.approx([28.0] * 3)
+    try:
+        results = _gather_results(procs, q, len(members), timeout=180)
+        _check_supported(procs, results)
+        for p in procs:
+            p.join(30)
+            if p.is_alive():
+                raise AssertionError("member process hung")
+        assert len(results) == len(members), (
+            f"member crashed; exit codes {[p.exitcode for p in procs]}"
+        )
+        assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+        assert sorted(r[2] for r in results) == pytest.approx([28.0] * 3)
+    finally:
+        # Every exit path — skip included — must reap the members, or a
+        # straggler blocks interpreter exit in multiprocessing's atexit.
+        _reap(procs)

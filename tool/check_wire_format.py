@@ -155,10 +155,25 @@ def compute_fingerprint() -> str:
             "epoch_tag_key": wire.EPOCH_TAG_KEY,
             "ring_stripe_schema": _schema(stripe_manifest),
             "ring_stripe_version": ring.RING_STRIPE_VERSION,
+            # Frame-metadata key constants declared in wire.py (*_KEY),
+            # extracted by fedlint's FED006 machinery — the same pass
+            # that forbids string-literal metadata keys in transport/
+            # and fl/.  Together they close the gap where a new ad-hoc
+            # key ships without ever reaching this lock: the literal
+            # fails FED006, and the constant it becomes lands HERE (a
+            # key-set change re-pins the lock, no wire bump — the frame
+            # layout is untouched, like the ring-stripe knob above).
+            "frame_metadata_keys": _declared_meta_keys(),
         },
         sort_keys=True,
     )
     return hashlib.sha256(material.encode()).hexdigest()
+
+
+def _declared_meta_keys():
+    from tool.fedlint.rules import declared_meta_keys
+
+    return dict(sorted(declared_meta_keys().items()))
 
 
 def main() -> int:

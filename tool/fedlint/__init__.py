@@ -1,0 +1,31 @@
+"""fedlint — machine-checked concurrency/aggregation contracts.
+
+The transport and aggregation stack (PRs 1–7) rests on invariants that
+used to live only in docstrings and reviewer memory: the comms lane
+never allocates seq ids, server-side hooks never block the shared
+receive loop, KeyboardInterrupt/SystemExit are re-raised unwrapped,
+donated accumulators are never read after donation, frame-metadata keys
+are declared constants, lock acquisition order is acyclic.  ``fedlint``
+encodes each as an AST rule (``tool/fedlint/rules.py``) and fails CI on
+violations, the same way ``tool/check_wire_format.py`` gates wire-layout
+drift.
+
+Run ``python -m tool.fedlint`` (CI does, via ``test.sh``) or
+``python -m tool.fedlint --list-rules`` for the catalog.  Suppress a
+finding only with an inline pragma carrying a written reason::
+
+    risky_call()  # fedlint: disable=FED001 — <why this is safe>
+
+The dynamic counterpart — orderings the static pass cannot see — is the
+runtime lock-order sanitizer, ``rayfed_tpu/_sanitizer.py``
+(``RAYFED_SANITIZE=1``).
+"""
+
+from tool.fedlint.engine import (  # noqa: F401
+    EXIT_FINDINGS,
+    Finding,
+    Project,
+    lint_paths,
+    lint_sources,
+)
+from tool.fedlint.rules import ALL_RULES, declared_meta_keys  # noqa: F401

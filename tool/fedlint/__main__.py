@@ -1,0 +1,15 @@
+"""``python -m tool.fedlint`` — run the contract rules (CI entry point)."""
+
+import os
+import sys
+
+# Allow invocation from anywhere inside the repo checkout.
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from tool.fedlint.engine import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

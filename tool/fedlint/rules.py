@@ -1,0 +1,905 @@
+"""fedlint rule catalog — every rule encodes an invariant this repo
+already shipped a bug against (the "origin" lines name the PR that paid
+for it).
+
+Rules are pure-AST, whole-project passes: each receives the
+:class:`~tool.fedlint.engine.Project` and yields
+:class:`~tool.fedlint.engine.Finding`s.  They prefer *narrow and sound
+over clever*: a static pass that can't prove a thread context stays
+silent, and the dynamic orderings it cannot see are the runtime
+sanitizer's job (``rayfed_tpu/_sanitizer.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tool.fedlint.engine import Finding, Project, SourceFile
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on parsed trees
+        return "<expr>"
+
+
+def _attr_chain_last(node: ast.AST) -> str:
+    """Last dotted segment of a receiver expression ('self._lock' → '_lock')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    """The called attribute/function name ('runtime.next_seq_id' → 'next_seq_id')."""
+    return _attr_chain_last(call.func)
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree WITHOUT descending into nested function bodies —
+    code in a nested def runs at some other time, on some other thread."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """(0,) / 0 / (0, 1) as a tuple of ints; None when not a literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+class Rule:
+    code: str = "FED000"
+    name: str = ""
+    summary: str = ""
+    origin: str = ""
+
+    def check(self, project: Project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, src: SourceFile, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            src.path,
+            getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0) + 1,
+            self.code,
+            message,
+        )
+
+
+# ---------------------------------------------------------------------------
+# FED001 — no blocking calls lexically inside an ``async def`` body
+# ---------------------------------------------------------------------------
+
+
+class NoBlockingInAsync(Rule):
+    code = "FED001"
+    name = "no-blocking-in-async"
+    summary = (
+        "time.sleep / lock acquire / Condition.wait / Future.result / "
+        "no-timeout queue get / blocking chaos.fire inside an `async def` "
+        "body stalls every peer sharing the event loop."
+    )
+    origin = (
+        "PR 7: a chaos delay_ms matched on the server's shared receive "
+        "loop slept every peer's frames (the fire_nonblocking fix) — a "
+        "bug class, not a bug."
+    )
+
+    _QUEUEISH = re.compile(r"(queue|_q)$|^q$", re.IGNORECASE)
+    # Matches FED007's notion of a lock-ish receiver: `with self._lock:`
+    # in a coroutine is the DOMINANT blocking-acquisition idiom — a
+    # threading lock contended from sync threads parks the whole loop.
+    # (async locks use `async with` = ast.AsyncWith, not flagged here.)
+    _LOCKISH = re.compile(r"(lock|cond|mutex)s?$", re.IGNORECASE)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.files:
+            awaited = {
+                n.value
+                for n in ast.walk(src.tree)
+                if isinstance(n, ast.Await)
+            }
+            # Anything inside an `await ...` expression: `await
+            # asyncio.wait_for(event.wait(), ...)` hands wait()'s
+            # CORO to the awaited wrapper — that's the asyncio idiom,
+            # not a blocking call (sleep/result/get stay flagged even
+            # there: they block while building the awaited expression).
+            await_reachable = {
+                c
+                for n in ast.walk(src.tree)
+                if isinstance(n, ast.Await)
+                for c in ast.walk(n.value)
+                if isinstance(c, ast.Call)
+            }
+            from_chaos_fire = any(
+                isinstance(n, ast.ImportFrom)
+                and (n.module or "").endswith("chaos")
+                and any(a.name == "fire" for a in n.names)
+                for n in ast.walk(src.tree)
+            )
+            info = {
+                "awaited": awaited,
+                "await_reachable": await_reachable,
+                "from_chaos_fire": from_chaos_fire,
+            }
+            yield from self._scan(src, src.tree, False, info)
+
+    def _scan(self, src, node, in_async, info):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                yield from self._scan(src, child, True, info)
+            elif isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                # A nested sync def/lambda runs whenever something calls
+                # it — not necessarily on the loop; out of scope here.
+                yield from self._scan(src, child, False, info)
+            else:
+                if in_async and isinstance(child, ast.Call) \
+                        and child not in info["awaited"]:
+                    msg = self._blocking(child, info)
+                    if msg:
+                        yield self.finding(src, child, msg)
+                if in_async and isinstance(child, ast.With):
+                    for item in child.items:
+                        last = _attr_chain_last(item.context_expr)
+                        if last and self._LOCKISH.search(last):
+                            expr = _unparse(item.context_expr)
+                            yield self.finding(
+                                src, child,
+                                f"`with {expr}:` in a coroutine — a "
+                                "threading lock contended from sync "
+                                "threads parks the whole event loop "
+                                "while held; use an asyncio lock "
+                                "(`async with`) or move the critical "
+                                "section off-loop",
+                            )
+                yield from self._scan(src, child, in_async, info)
+
+    def _blocking(self, call: ast.Call, info) -> Optional[str]:
+        func = call.func
+        name = _call_name(call)
+        recv = func.value if isinstance(func, ast.Attribute) else None
+        recv_txt = _unparse(recv) if recv is not None else ""
+        kwargs = {k.arg for k in call.keywords if k.arg}
+        if name == "sleep" and recv_txt == "time":
+            return ("time.sleep() blocks the event loop — "
+                    "use `await asyncio.sleep(...)`")
+        if name == "fire" and (recv_txt.endswith("chaos") or
+                               (recv is None and info["from_chaos_fire"])):
+            return ("blocking chaos.fire() in a coroutine — use "
+                    "`await chaos.fire_async(...)` (an injected delay_ms "
+                    "would sleep the whole loop; the PR 7 "
+                    "fire_nonblocking bug class)")
+        if name == "acquire" and call not in info["await_reachable"]:
+            blocking_kw = next(
+                (k.value for k in call.keywords if k.arg == "blocking"), None
+            )
+            if isinstance(blocking_kw, ast.Constant) and not blocking_kw.value:
+                return None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and not call.args[0].value:
+                return None
+            return (f"blocking `{recv_txt or '<lock>'}.acquire()` in a "
+                    "coroutine — a contended lock parks the whole loop; "
+                    "use asyncio primitives or move the work off-loop")
+        if name in ("wait", "wait_for") and recv_txt != "asyncio" \
+                and call not in info["await_reachable"]:
+            return (f"`{recv_txt or '<obj>'}.{name}()` without await in a "
+                    "coroutine — threading-style waits block the loop "
+                    "(asyncio waits must be awaited)")
+        if name == "result":
+            return (f"`{recv_txt or '<future>'}.result()` in a coroutine "
+                    "blocks the loop until the future resolves — await an "
+                    "asyncio future or wrap with asyncio.wrap_future")
+        if (
+            name == "get"
+            and not call.args
+            and not (kwargs & {"timeout", "block"})
+            and self._QUEUEISH.search(_attr_chain_last(recv) if recv else "")
+        ):
+            return (f"`{recv_txt}.get()` without timeout in a coroutine — "
+                    "an empty queue parks the whole loop forever")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# FED002 — loop-affine calls must not be reachable from non-loop threads
+# ---------------------------------------------------------------------------
+
+
+class LoopAffinity(Rule):
+    code = "FED002"
+    name = "loop-affinity"
+    summary = (
+        "loop.create_task / call_soon / call_later / asyncio.ensure_future "
+        "(and loop-future set_result/set_exception) from sync code — "
+        "asyncio loops are single-thread-affine; cross-thread entry must "
+        "go through call_soon_threadsafe / run_coroutine_threadsafe."
+    )
+    origin = (
+        "PR 5: the chunk-producer → rail handoff resolves per-chunk "
+        "futures strictly via loop.call_soon_threadsafe; an off-thread "
+        "create_task corrupts the loop's internal state silently."
+    )
+
+    _SCHED = {"create_task", "call_soon", "call_later", "call_at"}
+    _SAFE = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.files:
+            onloop_names, onloop_lambdas = self._collect_onloop(src)
+            yield from self._scan(
+                src, src.tree, "sync", onloop_names, onloop_lambdas
+            )
+
+    def _collect_onloop(self, src) -> Tuple[Set[str], Set[ast.AST]]:
+        """Callables handed to the loop's own scheduling APIs run ON the
+        loop — they are the allowed idiom, not violations."""
+        names: Set[str] = set()
+        lambdas: Set[ast.AST] = set()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _call_name(node)
+            if attr in self._SCHED | self._SAFE:
+                cb_index = 1 if attr in ("call_later", "call_at") else 0
+                if len(node.args) > cb_index:
+                    cb = node.args[cb_index]
+                    if isinstance(cb, (ast.Name, ast.Attribute)):
+                        names.add(_attr_chain_last(cb))
+                    elif isinstance(cb, ast.Lambda):
+                        lambdas.add(cb)
+        return names, lambdas
+
+    def _scan(self, src, node, ctx, onloop_names, onloop_lambdas):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                yield from self._scan(src, child, "loop", onloop_names,
+                                      onloop_lambdas)
+            elif isinstance(child, ast.FunctionDef):
+                # Nested defs inside a coroutine (done-callbacks, helpers)
+                # are loop-adjacent; top-level sync defs are loop-side only
+                # when something schedules them onto the loop by name.
+                child_ctx = (
+                    "loop"
+                    if ctx == "loop" or child.name in onloop_names
+                    else "sync"
+                )
+                yield from self._scan(src, child, child_ctx, onloop_names,
+                                      onloop_lambdas)
+            elif isinstance(child, ast.Lambda):
+                lam_ctx = "loop" if (ctx == "loop" or child in onloop_lambdas) \
+                    else "sync"
+                yield from self._scan(src, child, lam_ctx, onloop_names,
+                                      onloop_lambdas)
+            else:
+                if ctx == "sync" and isinstance(child, ast.Call):
+                    msg = self._loop_affine(child)
+                    if msg:
+                        yield self.finding(src, child, msg)
+                yield from self._scan(src, child, ctx, onloop_names,
+                                      onloop_lambdas)
+
+    def _loop_affine(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        name = _call_name(call)
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        recv_txt = _unparse(recv)
+        if name == "ensure_future" and recv_txt == "asyncio":
+            return ("asyncio.ensure_future() from sync code — only valid "
+                    "on the loop thread; use asyncio.run_coroutine_"
+                    "threadsafe(coro, loop) (or pragma with proof this "
+                    "runs on the loop)")
+        if name in self._SCHED:
+            last = _attr_chain_last(recv)
+            # `asyncio.get_running_loop().call_soon(...)` proves loop
+            # affinity at runtime (it raises off-loop) — allowed.
+            if isinstance(recv, ast.Call) and \
+                    _call_name(recv) == "get_running_loop":
+                return None
+            if "loop" in last.lower():
+                return (f"`{recv_txt}.{name}()` from sync code — loop-"
+                        "affine call; route through call_soon_threadsafe/"
+                        "run_coroutine_threadsafe (or pragma with proof "
+                        "this runs on the loop thread)")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# FED003 — no use-after-donate of buffers handed to donate_argnums
+# ---------------------------------------------------------------------------
+
+
+class UseAfterDonate(Rule):
+    code = "FED003"
+    name = "use-after-donate"
+    summary = (
+        "a binding passed at a donate_argnums position of a jitted "
+        "callable is dead — XLA may alias its buffer for the output; "
+        "reading it again is undefined (silently stale on CPU, garbage "
+        "on TPU)."
+    )
+    origin = (
+        "PR 2: StreamingAggregator's donated f32 accumulator cannot roll "
+        "back — a fold into a donated buffer followed by a read of the "
+        "old binding is the bug class behind the corrupt-mid-fold "
+        "hard-fail contract (fl/streaming.py, fl/fedavg.py, fl/overlap.py)."
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.files:
+            donated = self._collect_donated(src)
+            if not donated:
+                continue
+            yield from self._scan_calls(src, donated)
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect_donated(self, src) -> Dict[str, Tuple[int, ...]]:
+        """Map of callable expression text → donated positions.
+
+        Covers `X = jax.jit(f, donate_argnums=<literal>)` (X a name or
+        self-attribute) and `@functools.partial(jax.jit,
+        donate_argnums=<literal>)` decorated defs.  Non-literal donate
+        specs (config-driven) are out of static reach and skipped.
+        """
+        donated: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                pos = self._jit_donate_positions(node.value)
+                if pos:
+                    for target in node.targets:
+                        if isinstance(target, (ast.Name, ast.Attribute)):
+                            donated[_unparse(target)] = pos
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and \
+                            _call_name(dec) == "partial":
+                        if any(
+                            _unparse(a).endswith("jit") for a in dec.args
+                        ):
+                            pos = self._donate_kw(dec)
+                            if pos:
+                                donated[node.name] = pos
+        return donated
+
+    def _jit_donate_positions(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+        if _call_name(call) != "jit":
+            return None
+        return self._donate_kw(call)
+
+    def _donate_kw(self, call: ast.Call) -> Optional[Tuple[int, ...]]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return _literal_int_tuple(kw.value)
+        return None
+
+    # -- per-call-site analysis ---------------------------------------------
+
+    def _scan_calls(self, src, donated) -> Iterator[Finding]:
+        parents = src.parents()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            pos = donated.get(_unparse(node.func))
+            if not pos:
+                continue
+            for p in pos:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    yield from self._check_reads(
+                        src, parents, node, node.args[p].id, p
+                    )
+
+    def _enclosing(self, parents, node, kinds):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def _check_reads(self, src, parents, call, name, pos) -> Iterator[Finding]:
+        scope = self._enclosing(
+            parents, call, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) or src.tree
+        call_end = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+
+        reads: List[Tuple[Tuple[int, int], ast.AST]] = []
+        stores: List[Tuple[int, int]] = []
+        for n in _walk_skip_defs(scope):
+            if isinstance(n, ast.Name) and n.id == name:
+                if isinstance(n.ctx, ast.Store):
+                    # A store takes effect after its statement's value is
+                    # evaluated: `acc = fold(acc, x)` rebinding lands
+                    # AFTER the donating call, which is exactly the
+                    # correct idiom.
+                    stmt = self._enclosing(parents, n, (ast.stmt,))
+                    if stmt is not None:
+                        stores.append((stmt.end_lineno, stmt.end_col_offset))
+                elif isinstance(n.ctx, ast.Load):
+                    reads.append(((n.lineno, n.col_offset), n))
+
+        # Linear after-the-call scan: first event wins.
+        after_reads = sorted(p for p, _ in reads if p > call_end)
+        after_stores = sorted(p for p in stores if p >= call_end)
+        if after_reads and (
+            not after_stores or after_reads[0] < after_stores[0]
+        ):
+            read_pos = after_reads[0]
+            node = next(n for p, n in reads if p == read_pos)
+            yield self.finding(
+                src, node,
+                f"`{name}` was donated (donate_argnums position {pos}) to "
+                f"`{_unparse(call.func)}` on line {call.lineno} and read "
+                "again — the buffer may already be aliased; rebind the "
+                "result or pass a copy",
+            )
+            return
+
+        # Donating call inside a loop without rebinding: iteration k+1
+        # re-reads the binding iteration k donated.
+        loop = self._enclosing(parents, call, (ast.For, ast.While))
+        if loop is not None:
+            loop_stores = any(
+                isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Store)
+                for n in _walk_skip_defs(loop)
+            )
+            if not loop_stores:
+                yield self.finding(
+                    src, call,
+                    f"`{name}` is donated to `{_unparse(call.func)}` inside "
+                    "a loop without being rebound — the next iteration "
+                    "reads a donated buffer",
+                )
+
+
+# ---------------------------------------------------------------------------
+# FED004 — KeyboardInterrupt/SystemExit must not be swallowed
+# ---------------------------------------------------------------------------
+
+
+class SwallowedExit(Rule):
+    code = "FED004"
+    name = "swallowed-exit"
+    summary = (
+        "a bare `except:` / `except BaseException` (or a tuple naming "
+        "KeyboardInterrupt/SystemExit) that never re-raises absorbs an "
+        "operator abort — peers must be poisoned AND the exit re-raised "
+        "unwrapped."
+    )
+    origin = (
+        "PR 3: the ring-abort contract — a failing controller poisons "
+        "every key it owes but re-raises KeyboardInterrupt/SystemExit "
+        "unwrapped so ctrl-C actually stops the round."
+    )
+
+    _EXITISH = {"BaseException", "KeyboardInterrupt", "SystemExit"}
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.files:
+            if not src.path.startswith("rayfed_tpu/"):
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                caught = self._exitish_caught(node.type)
+                if caught is None:
+                    continue
+                if self._reraises(node):
+                    continue
+                yield self.finding(
+                    src, node,
+                    f"handler catches {caught} without any `raise` in its "
+                    "body — KeyboardInterrupt/SystemExit would be "
+                    "swallowed; re-raise (poison peers first if needed) "
+                    "or narrow to `except Exception`",
+                )
+
+    def _exitish_caught(self, type_node) -> Optional[str]:
+        if type_node is None:
+            return "everything (bare except)"
+        names = []
+        if isinstance(type_node, ast.Name):
+            names = [type_node.id]
+        elif isinstance(type_node, ast.Tuple):
+            names = [e.id for e in type_node.elts if isinstance(e, ast.Name)]
+        hit = sorted(set(names) & self._EXITISH)
+        return ", ".join(hit) if hit else None
+
+    def _reraises(self, handler: ast.ExceptHandler) -> bool:
+        # Any `raise` lexically in the handler (not inside a nested def)
+        # counts; distinguishing a bare re-raise from a wrapping raise is
+        # left to review — the rule targets silent absorption.
+        return any(
+            isinstance(n, ast.Raise) for n in _walk_skip_defs(handler)
+        ) or any(
+            # `os._exit(...)` is an even harder exit than re-raising.
+            isinstance(n, ast.Call) and _call_name(n) == "_exit"
+            for n in _walk_skip_defs(handler)
+        )
+
+
+# ---------------------------------------------------------------------------
+# FED005 — CommsLane-submitted callables never allocate seq ids
+# ---------------------------------------------------------------------------
+
+
+class SeqIdDiscipline(Rule):
+    code = "FED005"
+    name = "seq-id-discipline"
+    summary = (
+        "rendezvous seq ids are a cross-party program-order contract; a "
+        "callable submitted to executor.CommsLane must receive pre-drawn "
+        "ids (seq_ids=), never call runtime.next_seq_id() off-thread."
+    )
+    origin = (
+        "PR 4: pipelined rounds pre-draw STREAM_AGG_SEQ_IDS/RING_SEQ_IDS "
+        "on the main thread — an off-thread next_seq_id interleaves with "
+        "the next round's draws and desyncs every party's rendezvous keys."
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.files:
+            lane_vars = self._lane_vars(src)
+            if not lane_vars:
+                continue
+            mod_funcs, methods = self._index(src)
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Call)
+                        and _call_name(node) == "submit"
+                        and isinstance(node.func, ast.Attribute)
+                        and _unparse(node.func.value) in lane_vars
+                        and node.args):
+                    continue
+                root = node.args[0]
+                yield from self._check_root(
+                    src, node, root, mod_funcs, methods
+                )
+
+    def _lane_vars(self, src) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _call_name(node.value) == "CommsLane":
+                    for t in node.targets:
+                        if isinstance(t, (ast.Name, ast.Attribute)):
+                            out.add(_unparse(t))
+        return out
+
+    def _index(self, src):
+        mod_funcs: Dict[str, ast.AST] = {}
+        methods: Dict[str, List[ast.AST]] = {}
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod_funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        methods.setdefault(item.name, []).append(item)
+        return mod_funcs, methods
+
+    def _check_root(self, src, submit_call, root, mod_funcs, methods):
+        roots: List[ast.AST] = []
+        if isinstance(root, ast.Lambda):
+            roots = [root]
+        elif isinstance(root, ast.Name) and root.id in mod_funcs:
+            roots = [mod_funcs[root.id]]
+        elif isinstance(root, ast.Attribute):
+            roots = methods.get(root.attr, [])
+        seen: Set[ast.AST] = set()
+        queue = list(roots)
+        while queue:
+            fn = queue.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                if _call_name(n) == "next_seq_id":
+                    yield self.finding(
+                        src, n,
+                        "seq id allocated inside a callable submitted to "
+                        f"the CommsLane (submit at line "
+                        f"{submit_call.lineno}) — pre-draw ids on the "
+                        "main thread and pass them in (seq_ids=; the "
+                        "STREAM_AGG_SEQ_IDS/RING_SEQ_IDS contract)",
+                    )
+                # Same-module transitive closure: module functions by
+                # name, same-class/self methods by attribute.
+                elif isinstance(n.func, ast.Name) and n.func.id in mod_funcs:
+                    queue.append(mod_funcs[n.func.id])
+                elif (isinstance(n.func, ast.Attribute)
+                      and isinstance(n.func.value, ast.Name)
+                      and n.func.value.id == "self"
+                      and n.func.attr in methods):
+                    queue.extend(methods[n.func.attr])
+
+
+# ---------------------------------------------------------------------------
+# FED006 — frame-metadata keys must be declared constants in wire.py
+# ---------------------------------------------------------------------------
+
+
+def declared_meta_keys(wire_path: Optional[str] = None) -> Dict[str, str]:
+    """The frame-metadata key constants declared in transport/wire.py
+    (module-level ``*_KEY = "literal"``).  Single source for FED006 and
+    for ``tool/check_wire_format.py``'s drift fingerprint — an ad-hoc
+    key that never reaches wire.py can't reach the lock either.
+    """
+    if wire_path is None:
+        from tool.fedlint.engine import REPO_ROOT
+
+        wire_path = os.path.join(REPO_ROOT, "rayfed_tpu", "transport",
+                                 "wire.py")
+    with open(wire_path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    return _meta_keys_from_tree(tree)
+
+
+def _meta_keys_from_tree(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (isinstance(target, ast.Name) and target.id.endswith("_KEY")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out[target.id] = node.value.value
+    return out
+
+
+class WireMetadataKeys(Rule):
+    code = "FED006"
+    name = "wire-metadata-keys"
+    summary = (
+        "string-literal frame-metadata keys in transport/ or fl/ — every "
+        "key is a cross-party contract and must be a named *_KEY constant "
+        "in transport/wire.py (which the wire-format drift gate "
+        "fingerprints)."
+    )
+    origin = (
+        "PR 4/6: ROUND_TAG_KEY ('rnd') and EPOCH_TAG_KEY ('ep') ride the "
+        "ordinary meta dict — an ad-hoc literal key would silently dodge "
+        "tool/check_wire_format.py's fingerprint."
+    )
+
+    _METAISH = {"meta", "metadata", "send_meta", "merged_meta", "frame_meta"}
+    _SCOPES = ("rayfed_tpu/transport/", "rayfed_tpu/fl/")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.files:
+            if not src.path.startswith(self._SCOPES):
+                continue
+            if src.path.endswith("transport/wire.py"):
+                continue  # the declaration site itself
+            for node in ast.walk(src.tree):
+                yield from self._check_node(src, node)
+
+    def _is_metaish(self, node) -> bool:
+        return isinstance(node, ast.Name) and node.id in self._METAISH
+
+    def _lit(self, node) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None
+
+    def _check_node(self, src, node) -> Iterator[Finding]:
+        key = None
+        if isinstance(node, ast.Subscript) and self._is_metaish(node.value):
+            key = self._lit(node.slice)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in ("get", "pop", "setdefault")
+              and self._is_metaish(node.func.value)
+              and node.args):
+            key = self._lit(node.args[0])
+        elif (isinstance(node, ast.Compare)
+              and len(node.ops) == 1
+              and isinstance(node.ops[0], (ast.In, ast.NotIn))
+              and len(node.comparators) == 1
+              and self._is_metaish(node.comparators[0])):
+            key = self._lit(node.left)
+        if key is not None:
+            yield self.finding(
+                src, node,
+                f"frame-metadata key {key!r} as a string literal — declare "
+                "it as a *_KEY constant in transport/wire.py and use the "
+                "constant (declared keys feed the wire-format drift gate)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# FED007 — static lock-order: nested `with <lock>:` pairs must be acyclic
+# ---------------------------------------------------------------------------
+
+
+class _LockEdge:
+    __slots__ = ("src", "dst", "path", "line", "guards")
+
+    def __init__(self, src, dst, path, line, guards):
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.guards = guards
+
+
+class StaticLockOrder(Rule):
+    code = "FED007"
+    name = "static-lock-order"
+    summary = (
+        "nested `with <lock>:` acquisition pairs across the whole tree "
+        "form a global acquired-before graph — a cycle is a deadlock "
+        "waiting for the right interleaving."
+    )
+    origin = (
+        "PRs 2-7 grew ~19 locks across manager/server/wire/executor/"
+        "chaos; hand-auditing nesting stopped scaling.  (Dynamic, "
+        "callback-driven orderings are the runtime sanitizer's job: "
+        "rayfed_tpu/_sanitizer.py.)"
+    )
+
+    _LOCKISH = re.compile(r"(lock|cond|mutex)s?$", re.IGNORECASE)
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        edges: List[_LockEdge] = []
+        for src in project.files:
+            module_globals = {
+                t.id
+                for n in src.tree.body if isinstance(n, ast.Assign)
+                for t in n.targets if isinstance(t, ast.Name)
+            }
+            self._collect(src, src.tree, [], "", "", module_globals, edges)
+        yield from self._report_cycles(edges)
+
+    # -- lock identity -------------------------------------------------------
+
+    def _lock_id(self, expr, src, cls, fn, module_globals) -> Optional[Tuple]:
+        txt = _unparse(expr)
+        last = _attr_chain_last(expr)
+        if not last or not self._LOCKISH.search(last):
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id in (
+                    "self", "cls"):
+            return (src.path, cls, f"self.{expr.attr}")
+        if isinstance(expr, ast.Name):
+            if expr.id in module_globals:
+                return (src.path, "", expr.id)
+            return (src.path, cls, fn, expr.id)
+        # other attribute chains (conn.lock): per-function identity — two
+        # different instances must not unify across functions.
+        return (src.path, cls, fn, txt)
+
+    def _collect(self, src, node, held, cls, fn, module_globals, edges):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._collect(src, child, held, child.name, fn,
+                              module_globals, edges)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A new function body is a fresh dynamic extent: locks
+                # held at the `def` site are NOT held when it runs.
+                self._collect(src, child, [], cls, child.name,
+                              module_globals, edges)
+            elif isinstance(child, ast.With):
+                acquired = []
+                for item in child.items:
+                    lock = self._lock_id(item.context_expr, src, cls, fn,
+                                         module_globals)
+                    if lock is not None:
+                        for h in held + acquired:
+                            if h != lock:
+                                edges.append(_LockEdge(
+                                    h, lock, src.path, child.lineno,
+                                    frozenset(
+                                        x for x in held + acquired
+                                        if x not in (h, lock)
+                                    ),
+                                ))
+                        acquired.append(lock)
+                self._collect(src, child, held + acquired, cls, fn,
+                              module_globals, edges)
+            else:
+                self._collect(src, child, held, cls, fn, module_globals,
+                              edges)
+
+    # -- cycle detection -----------------------------------------------------
+
+    def _report_cycles(self, edges: List[_LockEdge]) -> Iterator[Finding]:
+        graph: Dict[Tuple, List[_LockEdge]] = {}
+        # Guard-lock refinement data: the guards an ordering is
+        # GUARANTEED to run under = the intersection over all its
+        # occurrences (parallel edges).  One occurrence outside the
+        # guard is enough to make the ordering unserialized, so the
+        # cycle check must not depend on which occurrence the DFS
+        # happens to walk first.
+        pair_guards: Dict[Tuple[Tuple, Tuple], frozenset] = {}
+        for e in edges:
+            graph.setdefault(e.src, []).append(e)
+            pair = (e.src, e.dst)
+            prev = pair_guards.get(pair)
+            pair_guards[pair] = e.guards if prev is None else prev & e.guards
+
+        reported: Set[frozenset] = set()
+
+        def dfs(start, node, path_edges, visited):
+            for e in graph.get(node, ()):
+                if e.dst == start:
+                    yield path_edges + [e]
+                elif e.dst not in visited:
+                    yield from dfs(start, e.dst, path_edges + [e],
+                                   visited | {e.dst})
+
+        for start in sorted(graph):
+            for cycle in dfs(start, start, [], {start}):
+                key = frozenset((e.src, e.dst) for e in cycle)
+                if key in reported:
+                    continue
+                reported.add(key)
+                # Serialized only when some guard covers EVERY
+                # occurrence of EVERY ordering in the cycle.
+                common = None
+                for e in cycle:
+                    g = pair_guards[(e.src, e.dst)]
+                    common = g if common is None else common & g
+                if common:
+                    continue
+                names = " → ".join(
+                    self._pretty(e.src) for e in cycle
+                ) + f" → {self._pretty(cycle[0].src)}"
+                sites = ", ".join(f"{e.path}:{e.line}" for e in cycle)
+                first = cycle[0]
+                yield Finding(
+                    first.path, first.line, 1, self.code,
+                    f"lock-order cycle {names} (acquisition sites: "
+                    f"{sites}) — pick one global order or collapse the "
+                    "locks",
+                )
+
+    @staticmethod
+    def _pretty(lock_id: Tuple) -> str:
+        path = os.path.basename(lock_id[0]).rsplit(".", 1)[0]
+        qual = [p for p in lock_id[1:] if p]
+        return f"{path}:{'.'.join(qual)}"
+
+
+ALL_RULES: Sequence[Rule] = (
+    NoBlockingInAsync(),
+    LoopAffinity(),
+    UseAfterDonate(),
+    SwallowedExit(),
+    SeqIdDiscipline(),
+    WireMetadataKeys(),
+    StaticLockOrder(),
+)
