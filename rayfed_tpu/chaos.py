@@ -338,6 +338,7 @@ def fire(hook: str, party: Optional[str] = None, **ctx: Any) -> None:
         return
     delay = _apply(rule, hook, party, ctx)
     if delay:
+        # fedlint: disable=FED001 — sleeping is this hook's PURPOSE (injected stall on the calling worker thread); every event-loop call site uses fire_async (awaited) or fire_nonblocking (delay skipped), the split FED001 itself polices
         time.sleep(delay)
 
 

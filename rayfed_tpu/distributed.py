@@ -503,7 +503,8 @@ class MultiHostTransport:
     # -- proxy interface ------------------------------------------------------
 
     def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
-             stream=None, round_tag=None, epoch_tag=None):
+             stream=None, round_tag=None, epoch_tag=None,
+             quant_meta=None):
         if self._inner is not None:
             return self._inner.send(
                 dest_party=dest_party,
@@ -513,13 +514,14 @@ class MultiHostTransport:
                 stream=stream,
                 round_tag=round_tag,
                 epoch_tag=epoch_tag,
+                quant_meta=quant_meta,
             )
         # Non-leader: the leader's identical program does the real push.
         return LocalRef.from_value(True)
 
     def send_many(self, dest_parties, data, upstream_seq_id,
                   downstream_seq_id, stream=None, round_tag=None,
-                  epoch_tag=None):
+                  epoch_tag=None, quant_meta=None):
         """Fan-out broadcast (one shared encode) — leader only; see
         :meth:`TransportManager.send_many`."""
         if self._inner is not None:
@@ -531,6 +533,7 @@ class MultiHostTransport:
                 stream=stream,
                 round_tag=round_tag,
                 epoch_tag=epoch_tag,
+                quant_meta=quant_meta,
             )
         return {p: LocalRef.from_value(True) for p in dest_parties}
 

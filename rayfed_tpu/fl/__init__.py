@@ -29,9 +29,18 @@ from rayfed_tpu.fl.compression import (
 from rayfed_tpu.fl.dp import clip_by_global_norm, privatize
 from rayfed_tpu.fl.fedavg import (
     aggregate,
+    packed_quantized_sum,
     packed_weighted_sum,
     tree_average,
     tree_weighted_sum,
+)
+from rayfed_tpu.fl.quantize import (
+    QuantCompressor,
+    QuantGrid,
+    QuantizedPackedTree,
+    dequantize_packed,
+    make_round_grid,
+    quantize_packed,
 )
 from rayfed_tpu.fl.overlap import PipelinedRoundRunner, dga_correct
 from rayfed_tpu.fl.quorum import (
@@ -64,6 +73,13 @@ from rayfed_tpu.fl.trainer import run_fedavg_rounds
 __all__ = [
     "aggregate",
     "packed_weighted_sum",
+    "packed_quantized_sum",
+    "QuantCompressor",
+    "QuantGrid",
+    "QuantizedPackedTree",
+    "dequantize_packed",
+    "make_round_grid",
+    "quantize_packed",
     "streaming_aggregate",
     "ring_aggregate",
     "RingRoundError",

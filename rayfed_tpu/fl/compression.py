@@ -45,6 +45,22 @@ Usage (each side of the exchange):
 
 ``compress(tree, packed=True)`` selects the packed form; ``decompress``
 accepts either form transparently.
+
+**Codec/aggregator split.**  This module is pure *codec*: wire forms
+(:class:`PackedTree`, and the shared-grid integer form
+:class:`~rayfed_tpu.fl.quantize.QuantizedPackedTree` from
+:mod:`rayfed_tpu.fl.quantize`, re-exported here) plus the sender-side
+residual state that keeps lossy codecs convergent
+(:class:`ErrorFeedback` for plain dtype narrowing,
+:class:`~rayfed_tpu.fl.quantize.QuantCompressor` for the grid codec).
+Nothing here folds: the *aggregator* half — the fold kernels, the
+single finalizes, and the per-wire-form kernel selection — lives in
+:mod:`rayfed_tpu.fl.fedavg` (``packed_weighted_sum`` /
+``packed_quantized_sum`` and their shared finalizes) and
+:mod:`rayfed_tpu.fl.streaming` (the streamed/striped folds), which
+pick a float or widening-integer accumulate from the codec's wire
+dtype.  Decode paths dispatch through ``tree.unpack`` so every wire
+form knows how to restore itself.
 """
 
 from __future__ import annotations
@@ -334,7 +350,54 @@ def compress(tree: Any, *, packed: bool = False, wire_dtype: Any = jnp.bfloat16)
 
 
 def decompress(tree: Any, dtype=jnp.float32) -> Any:
-    """Restore a wire-compressed tree (either form) to the compute dtype."""
+    """Restore a wire-compressed tree (any form) to the compute dtype.
+
+    Dispatches through ``tree.unpack`` so subclasses with their own
+    decode (the shared-grid integer form dequantizes first) restore
+    correctly.
+    """
     if isinstance(tree, PackedTree):
-        return unpack_tree(tree, dtype)
+        return tree.unpack(dtype)
     return cast_floats(tree, dtype)
+
+
+# Re-export the shared-grid integer codec: one import surface for wire
+# forms.  Lazy (PEP 562) because rayfed_tpu.fl.quantize subclasses
+# PackedTree and therefore imports THIS module first — an eager import
+# here would be circular when quantize is imported before compression.
+_QUANTIZE_EXPORTS = (
+    "QuantCompressor",
+    "QuantGrid",
+    "QuantizedPackedTree",
+    "dequantize_packed",
+    "make_round_grid",
+    "quantize_packed",
+)
+
+
+def __getattr__(name: str):
+    if name in _QUANTIZE_EXPORTS:
+        from rayfed_tpu.fl import quantize
+
+        return getattr(quantize, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
+__all__ = [
+    "PackSpec",
+    "PackedTree",
+    "ErrorFeedback",
+    "cast_floats",
+    "compress",
+    "decompress",
+    "pack_tree",
+    "unpack_tree",
+    "QuantCompressor",
+    "QuantGrid",
+    "QuantizedPackedTree",
+    "dequantize_packed",
+    "make_round_grid",
+    "quantize_packed",
+]

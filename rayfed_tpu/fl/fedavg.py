@@ -12,7 +12,7 @@ device, and the cross-party hop is the only DCN traffic.
 from __future__ import annotations
 
 import functools
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -163,6 +163,229 @@ def finalize_packed_stripe(acc, total_w: float, total_elems: int, out_dtype):
     )(acc, np.float32(total_w))
 
 
+# ---------------------------------------------------------------------------
+# Compressed-domain (shared-grid integer) aggregation — the aggregator
+# half of the fl.quantize codec/aggregator split.  The sum commutes with
+# the shared grid: sum_i w_i*x_i == scale_b*(sum_i w_i*q_i - zp_b*W), so
+# the fold is a widening i32 multiply-add over the integer codes and the
+# rescale happens ONCE at finalize.  Integer adds are exact and
+# associative, which is what makes the streamed, one-shot, ring-striped
+# and quorum-subset folds byte-identical by construction.
+# ---------------------------------------------------------------------------
+
+
+def quant_weights(
+    weights: Optional[Sequence[float]], n: int
+) -> Tuple[List[int], int]:
+    """Integer weight vector for the compressed-domain fold.
+
+    The i32 accumulator holds ``sum_i w_i * q_i`` exactly only for
+    non-negative **integral** weights (FedAvg example counts are) —
+    fractional or negative weights would break both exactness and the
+    overflow bound.  Returns ``(per-source ints, total)``; raises
+    naming the offending weight otherwise.
+    """
+    if weights is None:
+        return [1] * n, n
+    if len(weights) != n:
+        raise ValueError(f"{len(weights)} weights for {n} sources")
+    out: List[int] = []
+    for i, w in enumerate(weights):
+        f = float(w)
+        if not np.isfinite(f) or f < 0 or f != int(f):
+            raise ValueError(
+                f"compressed-domain aggregation needs non-negative "
+                f"integral weights (example counts); weight {i} is "
+                f"{w!r} — pre-scale to integers or use the float path"
+            )
+        out.append(int(f))
+    total = sum(out)
+    if total == 0:
+        raise ValueError(
+            "weights sum to zero — the weighted average is undefined"
+        )
+    return out, total
+
+
+@functools.lru_cache(maxsize=None)
+def quantized_accum_kernel(chunk_elems: int, wire_dtype: str):
+    """One donated-i32-accumulator widening multiply-add step:
+    ``acc[off:off+C] += w * widen(q)``.
+
+    The integer sibling of the streaming f32 chunk kernel
+    (``fl.streaming._accum_kernel``) and of the per-party chain inside
+    :func:`packed_quantized_sum` — integer adds are exact, so all of
+    them agree bit-for-bit in ANY fold order, and the single fused
+    rescale (:func:`finalize_packed_quantized`) is the only place
+    floats appear.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    del wire_dtype  # codes widen to i32 whatever the wire width
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _apply(acc, chunk, off, w):
+        seg = jax.lax.dynamic_slice(acc, (off,), (chunk_elems,))
+        return jax.lax.dynamic_update_slice(
+            acc, seg + w * chunk.astype(jnp.int32), (off,)
+        )
+
+    return _apply
+
+
+@functools.lru_cache(maxsize=None)
+def _quant_reduce_jit(nblocks: int, chunk_elems: int):
+    """One-shot integer reduce: widen + weighted-add chain over the
+    packed code buffers, padded onto the canonical block grid (the
+    same padded accumulator shape the streaming fold carries)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _reduce(bufs, w):
+        acc = jnp.zeros(nblocks * chunk_elems, jnp.int32)
+        for i, b in enumerate(bufs):
+            acc = acc.at[: b.shape[0]].add(w[i] * b.astype(jnp.int32))
+        return acc
+
+    return _reduce
+
+
+@functools.lru_cache(maxsize=None)
+def _quant_finalize_jit(chunk_elems: int, total_elems: int,
+                        out_dtype_name: str, with_ref: bool):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _finish(acc, ref, scales, zps, total_w):
+        a = acc.reshape(-1, chunk_elems).astype(jnp.float32)
+        x = scales[:, None] * (a - zps[:, None] * total_w)
+        x = x.reshape(-1)[:total_elems] / total_w
+        if with_ref:
+            # Delta-coded rounds: the codes summed to W·(mean delta);
+            # the shared reference (every party holds it bit-
+            # identically) adds back AFTER the divide, elementwise.
+            x = ref + x
+        return x.astype(jnp.dtype(out_dtype_name))
+
+    return _finish
+
+
+def finalize_packed_quantized(
+    acc, scales, zps, total_w: float, total_elems: int,
+    chunk_elems: int, out_dtype, ref=None,
+):
+    """THE compressed-domain finalize: the single fused rescale
+    ``[ref +] (scale_b * (acc - zp_b*W)) / W`` over a block-grid-padded
+    i32 accumulator holding ``sum_i w_i * q_i``.
+
+    ``ref`` (delta-coded rounds): the shared reference buffer the codes
+    were taken against — a flat f32 array of ``total_elems`` elements
+    (a stripe owner passes its stripe-compacted slice).
+
+    The quantized sibling of :func:`finalize_packed_stripe`, and like
+    it the SINGLE producer of the output bytes for every topology: the
+    one-shot reduce, the streaming aggregator, each ring stripe owner
+    (with its block-subset ``scales``/``zps`` rows and reference
+    slice) and the quorum refold all call exactly this.  Elementwise
+    with per-block parameters, so a stripe's rows produce exactly the
+    bytes the whole-buffer finalize produces at those element
+    positions.
+    """
+    import jax.numpy as jnp
+
+    with_ref = ref is not None
+    if with_ref:
+        ref = jnp.asarray(np.asarray(ref).reshape(-1), jnp.float32)
+        if int(ref.size) != int(total_elems):
+            raise ValueError(
+                f"reference has {ref.size} elements, finalize covers "
+                f"{total_elems}"
+            )
+    else:
+        ref = jnp.zeros(0, jnp.float32)
+    return _quant_finalize_jit(
+        int(chunk_elems), int(total_elems), np.dtype(out_dtype).name,
+        with_ref,
+    )(acc, ref, np.asarray(scales, np.float32),
+      np.asarray(zps, np.float32), np.float32(total_w))
+
+
+def packed_quantized_sum(
+    quantized_trees: Sequence[Any],
+    weights: Optional[Sequence[float]] = None,
+    out_dtype: Any = None,
+    ref: Any = None,
+):
+    """Fused compressed-domain reduce over QuantizedPackedTree
+    contributions sharing one grid — the one-shot reference every
+    streamed/striped/quorum integer fold is asserted bit-identical to.
+
+    ``ref``: the shared reference buffer for delta-coded contributions
+    (``grid.mode == "delta"``) — the finalize adds it back.
+
+    ``out_dtype`` defaults to **float32** (re-coding the mean onto the
+    8-bit grid would be exactly the loss no residual compensates; the
+    downlink quantizes separately, with its own grid and residual).
+    """
+    from rayfed_tpu.fl.quantize import QuantizedPackedTree, _check_ref
+
+    packeds = list(quantized_trees)
+    if not packeds:
+        raise ValueError("packed_quantized_sum needs at least one tree")
+    for i, p in enumerate(packeds):
+        if not isinstance(p, QuantizedPackedTree):
+            raise ValueError(
+                f"contribution {i} is not a QuantizedPackedTree (got "
+                f"{type(p).__name__}) — quantize with "
+                f"fl.quantize.quantize_packed(tree, grid)"
+            )
+    gmeta = packeds[0].gmeta
+    spec = packeds[0].spec
+    for i, p in enumerate(packeds[1:], 1):
+        if p.gmeta != gmeta or p.spec != spec:
+            raise ValueError(
+                f"contribution {i} was coded on a different grid "
+                f"(fp={p.gmeta.fp:#010x} vs {gmeta.fp:#010x}) — all "
+                f"parties must quantize onto the round's shared grid"
+            )
+    n = len(packeds)
+    iw, itotal = quant_weights(weights, n)
+    grid = packeds[0].grid()
+    grid.check_weight_headroom(itotal)
+    ref = _check_ref(grid, ref)
+    nblocks = packed_block_grid(gmeta.total_elems, gmeta.chunk_elems)
+    acc = _quant_reduce_jit(nblocks, gmeta.chunk_elems)(
+        tuple(p.buf for p in packeds),
+        np.asarray(iw, np.int32),
+    )
+    total_w = float(itotal)
+    out_name = np.dtype(
+        out_dtype if out_dtype is not None else np.float32
+    ).name
+    buf = finalize_packed_quantized(
+        acc, grid.scales, grid.zps, total_w, gmeta.total_elems,
+        gmeta.chunk_elems, out_name, ref=ref,
+    )
+    passthrough = _reduce_passthrough(
+        [p.passthrough for p in packeds],
+        None if weights is None else list(weights),
+        total_w,
+    )
+    return _packed_result(buf, passthrough, spec, out_name)
+
+
+def _packed_result(buf, passthrough, spec, out_name):
+    """Plain (float) PackedTree around a finalized aggregate buffer."""
+    from rayfed_tpu.fl.compression import PackedTree, PackSpec
+
+    if out_name != spec.wire_dtype:
+        spec = PackSpec(spec.entries, spec.treedef, out_name)
+    return PackedTree(buf, passthrough, spec)
+
+
 def _reduce_passthrough(passthroughs, weights, total):
     """Average the non-float (passthrough) leaf tuples of N PackedTrees
     with :func:`tree_average`'s per-leaf semantics.  Shared by the
@@ -205,10 +428,17 @@ def packed_weighted_sum(
     would compensate.
     """
     from rayfed_tpu.fl.compression import PackedTree
+    from rayfed_tpu.fl.quantize import QuantizedPackedTree
 
     packeds = list(packed_trees)
     if not packeds:
         raise ValueError("packed_weighted_sum needs at least one tree")
+    if any(isinstance(p, QuantizedPackedTree) for p in packeds):
+        raise ValueError(
+            "packed_weighted_sum got QuantizedPackedTree contributions "
+            "— their buffers are integer CODES, not values; fold them "
+            "with packed_quantized_sum (the compressed-domain reduce)"
+        )
     if not isinstance(packeds[0], PackedTree):
         raise ValueError(
             f"contribution 0 is not a PackedTree "
@@ -260,7 +490,21 @@ def tree_average(trees: Sequence[Any], weights: Optional[Sequence[float]] = None
     if weights is not None and len(weights) != len(trees):
         raise ValueError(f"{len(weights)} weights for {len(trees)} trees")
     from rayfed_tpu.fl.compression import PackedTree
+    from rayfed_tpu.fl.quantize import QuantizedPackedTree
 
+    if all(isinstance(t, QuantizedPackedTree) for t in trees):
+        if trees[0].gmeta.mode != "abs":
+            # Delta codes only mean something against the round's
+            # shared reference buffer, which this signature cannot
+            # carry — send callers to the explicit reduce.
+            raise ValueError(
+                "tree_average cannot fold delta-coded "
+                "QuantizedPackedTree contributions (the codes are "
+                "relative to the round's shared reference) — call "
+                "packed_quantized_sum(trees, weights, ref=<shared "
+                "reference buffer>) directly"
+            )
+        return packed_quantized_sum(trees, weights)
     if all(isinstance(t, PackedTree) for t in trees) and all(
         t.spec == trees[0].spec for t in trees[1:]
     ):

@@ -146,6 +146,9 @@ def quorum_aggregate(
     announce_fn: Optional[Callable[[List[str]], tuple]] = None,
     backstop: Optional[float] = None,
     timings: Optional[Dict[str, float]] = None,
+    quant: Optional[Any] = None,
+    quant_ref: Optional[Any] = None,
+    quant_scope: Optional[str] = None,
 ) -> QuorumRoundOutcome:
     """One k-of-n streaming round over the coordinator topology.
 
@@ -160,6 +163,20 @@ def quorum_aggregate(
     coordinator after the cutoff: it drains join/leave requests, folds
     in monitor-declared deaths, and advances the roster — the driver
     supplies it so this function stays transport-pure.
+
+    ``quant``: the round's shared
+    :class:`~rayfed_tpu.fl.quantize.QuantGrid` — the quorum round runs
+    **in the compressed domain**: contributions are quantized onto the
+    grid before the push (frames carry the grid descriptor), the
+    coordinator folds integer codes into a donated i32 accumulator,
+    and the deadline-gated cutoff's refold over the arrived member
+    subset stays bit-identical to
+    :func:`~rayfed_tpu.fl.fedavg.packed_quantized_sum` over that
+    subset (integer adds are exact whatever the refold order).  The
+    broadcast carries the finalized f32 aggregate.  ``quant_scope``
+    keys the error-feedback residual as in ``streaming_aggregate``;
+    it commits only when this round's broadcast lands, so a failover
+    re-push re-quantizes the SAME update with the SAME residual.
     """
     from rayfed_tpu.proxy import recv_on_runtime
 
@@ -172,6 +189,18 @@ def quorum_aggregate(
     )
     t0 = time.perf_counter()
 
+    # ONE shared sender-side codec discipline (fl.quantize.RoundCodec:
+    # grid-fingerprint check + EF two-phase commit, identical across
+    # streaming/ring/quorum); no-op when quant is None.
+    from rayfed_tpu.fl.quantize import RoundCodec
+
+    codec = RoundCodec(quant, quant_ref, quant_scope)
+    qref = codec.ref
+    q_descriptor = codec.descriptor
+    _to_wire = codec.to_wire
+    _quant_commit = codec.commit
+    _quant_rollback = codec.rollback
+
     # Quorum control-plane sends go DIRECTLY through the transport, not
     # proxy.send_on_runtime: that helper registers every ref with the
     # cleanup send-watchdog, and with exit_on_failure_cross_silo_sending
@@ -180,10 +209,13 @@ def quorum_aggregate(
     # healthy process.  Partial failure is this path's normal weather.
     if me != coordinator:
         obj = updates[me]
+        local_ref = obj.get_local_ref()
+        if quant is not None:
+            local_ref = local_ref.then(_to_wire)
         runtime.send_proxy.send(
-            coordinator, obj.get_local_ref(), f"{down}.up.{me}",
+            coordinator, local_ref, f"{down}.up.{me}",
             down, stream=f"{stream}/up/{me}", round_tag=round_index,
-            epoch_tag=epoch,
+            epoch_tag=epoch, quant_meta=q_descriptor,
         )
         # The push result is deliberately not awaited as a success
         # gate: a late push may be epoch-rejected (the membership
@@ -194,10 +226,12 @@ def quorum_aggregate(
                 runtime, coordinator, f"{down}.down", down
             ).resolve(timeout=backstop)
         except BaseException as exc:
+            _quant_rollback()
             raise QuorumRoundError(
                 f"round {round_index}: result broadcast from coordinator "
                 f"{coordinator!r} failed: {exc!r}"
             ) from exc
+        _quant_commit()
         if timings is not None:
             timings["agg_s"] = time.perf_counter() - t0
         return QuorumRoundOutcome(
@@ -211,12 +245,19 @@ def quorum_aggregate(
     w_list = (
         None if weights is None else [float(weights[p]) for p in parties]
     )
+    agg_kwargs = {}
+    if quant is not None:
+        # The fold grid IS the quantization grid.
+        agg_kwargs["chunk_elems"] = quant.chunk_elems
+        agg_kwargs["quant_ref"] = qref
     agg = StreamingAggregator(
         len(parties),
         weights=w_list,
         allowed=runtime.cluster_config.serializing_allowed_list,
         quorum=min(int(quorum), len(parties)),
         labels=parties,
+        quant=quant,
+        **agg_kwargs,
     )
     sink_entries = []
     cancel_keys = []
@@ -231,7 +272,11 @@ def quorum_aggregate(
                     # under quorum, like any other party's failure.
                     agg._on_error(i, exc)
                 else:
-                    agg.add_local(i, ref.resolve())
+                    try:
+                        agg.add_local(i, _to_wire(ref.resolve()))
+                    # fedlint: disable=FED004 — transferred, not swallowed: a quantize failure of the coordinator's OWN update is survivable under quorum exactly like its training failing
+                    except BaseException as e:
+                        agg._on_error(i, e)
 
             local_ref.add_done_callback(_feed)
         else:
@@ -262,6 +307,7 @@ def quorum_aggregate(
         if announce_fn is not None:
             announce, welcomes = announce_fn(members)
     except BaseException as exc:
+        _quant_rollback()
         # Peers are parked on the broadcast — poison it so they learn
         # the round died now, not at their backstop.
         _poison_round_key(runtime, others, f"{down}.down", down, exc)
@@ -270,6 +316,7 @@ def quorum_aggregate(
         raise QuorumRoundError(
             f"round {round_index}: quorum aggregation failed: {exc!r}"
         ) from exc
+    _quant_commit()
     # The round is decided but nobody has heard: the chaos "announce"
     # hook sits exactly here so a harness can kill the coordinator in
     # the nastiest window (peers parked on the broadcast with no poison

@@ -74,7 +74,10 @@ logger = logging.getLogger(__name__)
 # schema) by tool/check_wire_format.py: stripe payloads are a
 # cross-party contract layered on the ordinary payload manifest, so
 # drift must be deliberate.  The frame layout itself is untouched.
-RING_STRIPE_VERSION = 1
+# History: 1 = original; 2 = optional "qg" field (the shared
+# quantization grid's fingerprint on compressed-domain "rs" stripes —
+# receivers cross-check it before folding integer codes).
+RING_STRIPE_VERSION = 2
 
 # Module-level round counters (mirrors rayfed_tpu.metrics' style of
 # cheap global accounting): the trainer's fallback path and tests read
@@ -120,6 +123,7 @@ def make_stripe_meta(
     total_elems: int,
     dtype: str,
     phase: str,
+    qgrid_fp: Optional[int] = None,
 ) -> Dict[str, Any]:
     """The ``rsm`` sideband of a stripe payload — single producer of its
     schema (``tool/check_wire_format.py`` fingerprints it).
@@ -128,8 +132,13 @@ def make_stripe_meta(
     reduced stripe on the gather ring).  Receivers cross-check it
     against their independently derived schedule so a mis-wired payload
     fails loudly instead of folding into the wrong offsets.
+
+    ``qgrid_fp`` (v2, compressed-domain rounds): the shared
+    quantization grid's fingerprint — "rs" stripes carry integer codes
+    whose meaning IS the grid, so a receiver folding them into its i32
+    accumulator first proves both ends derived the identical grid.
     """
-    return {
+    rsm = {
         "v": RING_STRIPE_VERSION,
         "s": int(stripe),
         "n": int(n_stripes),
@@ -138,6 +147,9 @@ def make_stripe_meta(
         "dt": str(dtype),
         "ph": str(phase),
     }
+    if qgrid_fp is not None:
+        rsm["qg"] = int(qgrid_fp)
+    return rsm
 
 
 def _stripe_slice(buf: np.ndarray, blocks: Sequence[int], chunk_elems: int,
@@ -194,6 +206,9 @@ def ring_aggregate(
     round_tag: Optional[int] = None,
     timings: Optional[Dict[str, float]] = None,
     expect_parties: Optional[Sequence[str]] = None,
+    quant: Optional[Any] = None,
+    quant_ref: Optional[Any] = None,
+    quant_scope: Optional[str] = None,
 ) -> Any:
     """FedAvg round over the chunk-striped ring (see module docstring).
 
@@ -221,6 +236,30 @@ def ring_aggregate(
     (``wire.ROUND_TAG_KEY``).  ``timings`` (optional dict) receives
     ``push_s`` (reduce-scatter pushes ACKed) and ``agg_s`` (whole-call
     wall).
+
+    ``quant``: the round's shared
+    :class:`~rayfed_tpu.fl.quantize.QuantGrid` — the reduce-scatter
+    runs **in the compressed domain**: each party quantizes its
+    contribution onto the grid (pre-quantized contributions pass a
+    fingerprint check), stripe payloads carry integer codes (half the
+    bf16 bytes) with the grid fingerprint in their ``rsm`` manifest,
+    and each stripe owner folds codes into a donated i32 accumulator
+    with ONE fused rescale at finalize
+    (:class:`~rayfed_tpu.fl.streaming.StripeAggregator` integer path).
+    The all-gather then carries the finalized float stripes (they are
+    the round's OUTPUT — re-coding them would quantize the mean, the
+    loss no residual compensates), so the quantized ring saves the
+    reduce-scatter half of the wire.  ``quant_ref``: the round's
+    shared reference buffer for ``mode="delta"`` grids (parties code
+    ``update − ref``; each stripe owner's finalize adds back its
+    compacted reference slice).  ``out_dtype`` defaults to f32;
+    the result is byte-identical to
+    :func:`~rayfed_tpu.fl.fedavg.packed_quantized_sum` over the same
+    contributions and therefore to the compressed-domain coordinator
+    topology.  ``quant_scope`` keys the per-process error-feedback
+    residual exactly as in ``streaming_aggregate`` — committed only
+    when the round lands, so the coordinator fallback re-quantizes the
+    SAME update with the SAME residual after a ring abort.
 
     ``expect_parties``: the controllers expected to be LIVE this round
     (default: the whole cluster config).  Elastic-membership callers
@@ -366,16 +405,39 @@ def ring_aggregate(
                     me, p,
                 )
 
+    # Compressed-domain plumbing: ONE shared sender-side codec
+    # discipline (fl.quantize.RoundCodec — grid-fingerprint check + EF
+    # two-phase commit, identical across streaming/ring/quorum, so the
+    # ring-abort → coordinator-fallback path re-quantizes with the
+    # SAME residual by construction).  No-op when quant is None.
+    from rayfed_tpu.fl.quantize import RoundCodec
+
+    codec = RoundCodec(quant, quant_ref, quant_scope)
+    qref = codec.ref
+    q_descriptor = codec.descriptor
+    _to_wire = codec.to_wire
+    _quant_commit = codec.commit
+    _quant_rollback = codec.rollback
+
     if n == 1:
         # Degenerate single-party ring: reduce locally with the same
         # fused chain; still serve any non-member controllers.
-        from rayfed_tpu.fl.fedavg import packed_weighted_sum
+        from rayfed_tpu.fl.fedavg import (
+            packed_quantized_sum,
+            packed_weighted_sum,
+        )
 
         try:
             value = objs[0].get_local_ref().resolve(timeout=backstop)
-            result = packed_weighted_sum(
-                [value], weights, out_dtype=out_dtype
-            )
+            if quant is not None:
+                result = packed_quantized_sum(
+                    [_to_wire(value)], weights, out_dtype=out_dtype,
+                    ref=qref,
+                )
+            else:
+                result = packed_weighted_sum(
+                    [value], weights, out_dtype=out_dtype
+                )
             if non_members:
                 _broadcast_non_members(result)
                 _release_non_members()
@@ -389,12 +451,14 @@ def ring_aggregate(
             # Same contract as the main path: the poison unparks any
             # non-member controllers, but an interrupt must stop the
             # caller unwrapped.
+            _quant_rollback()
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
             RING_STATS["rounds_aborted"] += 1
             if isinstance(exc, RingRoundError):
                 raise
             raise RingRoundError(f"ring round aborted: {exc!r}") from exc
+        _quant_commit()
         RING_STATS["rounds_completed"] += 1
         return result
 
@@ -413,6 +477,14 @@ def ring_aggregate(
                 f"{type(my_packed).__name__} — produce updates with "
                 "fl.compress(tree, packed=True)"
             )
+        if quant is not None:
+            if int(chunk_elems) != quant.chunk_elems:
+                raise ValueError(
+                    f"ring chunk grid ({chunk_elems} elems) must match "
+                    f"the quantization grid ({quant.chunk_elems}) — "
+                    f"both ARE the canonical packed_block_grid chunking"
+                )
+            my_packed = _to_wire(my_packed)
         buf = np.asarray(my_packed.buf).reshape(-1)
         if buf.size == 0:
             raise ValueError(
@@ -423,7 +495,13 @@ def ring_aggregate(
         total_elems = int(buf.size)
         nblocks = packed_block_grid(total_elems, chunk_elems)
         stripes = packed_stripe_schedule(nblocks, n)
-        out_dt = np.dtype(out_dtype) if out_dtype is not None else wire_dt
+        # Compressed-domain output defaults to f32 — the finalized
+        # stripes are the round's OUTPUT, never re-coded.
+        out_dt = (
+            np.dtype(out_dtype) if out_dtype is not None
+            else (np.dtype(np.float32) if quant is not None else wire_dt)
+        )
+        q_fp = None if quant is None else quant.fingerprint()
 
         def elems(k: int) -> int:
             return _stripe_elems(
@@ -437,10 +515,25 @@ def ring_aggregate(
                 "s": m, "n": n, "nb": nblocks, "el": total_elems,
                 "dt": wire_dt.name, "ph": "rs",
             }
+            if q_fp is not None:
+                # Integer codes mean nothing without the grid: prove
+                # both ends derived the identical one before any fold.
+                rs_want["qg"] = q_fp
             agg = _make_stripe_agg(
-                runtime, len(objs), weights, out_dtype, my_stripe_elems,
+                runtime, len(objs), weights,
+                out_dt.name if quant is not None else out_dtype,
+                my_stripe_elems,
                 chunk_elems, label=f"stripe {m}",
                 meta_check=lambda v: _check_meta(v, rs_want),
+                quant=quant, quant_blocks=stripes[m],
+                # This owner's stripe-compacted slice of the shared
+                # reference — its finalize adds back exactly the
+                # elements its blocks cover.
+                quant_ref=(
+                    None if qref is None else _stripe_slice(
+                        qref, stripes[m], chunk_elems, total_elems
+                    )
+                ),
             )
             entries = []
             for i, obj in enumerate(objs):
@@ -465,7 +558,8 @@ def ring_aggregate(
                 ),
                 "rsm": json.dumps(
                     make_stripe_meta(
-                        k, n, nblocks, total_elems, wire_dt.name, "rs"
+                        k, n, nblocks, total_elems, wire_dt.name, "rs",
+                        qgrid_fp=q_fp,
                     ),
                     sort_keys=True,
                 ),
@@ -483,6 +577,7 @@ def ring_aggregate(
                         runtime, ring[k], payload,
                         f"{rs_id}.rs.{my_idx}.{k}", rs_id,
                         stream=f"{stream}/rs", round_tag=round_tag,
+                        quant_meta=q_descriptor,
                     ),
                 )
             )
@@ -656,6 +751,7 @@ def ring_aggregate(
             if m < n - 1:
                 _token_send(f"{release_id}.r.{m + 1}", release_id)
     except BaseException as exc:
+        _quant_rollback()
         for up, down in pending_cancels:
             transport.cancel_stream(up, down)
         _poison_ring_edges(
@@ -678,6 +774,7 @@ def ring_aggregate(
             _release_non_members()
         except Exception:  # pragma: no cover - post-commit best effort
             logger.exception("[%s] non-member release pass failed", me)
+    _quant_commit()
     RING_STATS["rounds_completed"] += 1
     if timings is not None:
         timings.setdefault("push_s", 0.0)
@@ -686,7 +783,8 @@ def ring_aggregate(
 
 
 def _make_stripe_agg(runtime, n_sources, weights, out_dtype, expect_elems,
-                     chunk_elems, label, meta_check=None):
+                     chunk_elems, label, meta_check=None, quant=None,
+                     quant_blocks=None, quant_ref=None):
     from rayfed_tpu.fl.streaming import StripeAggregator
 
     return StripeAggregator(
@@ -701,6 +799,13 @@ def _make_stripe_agg(runtime, n_sources, weights, out_dtype, expect_elems,
         expect_elems=expect_elems,
         label=label,
         meta_check=meta_check,
+        # Compressed-domain rounds: integer codes fold into a donated
+        # i32 accumulator; quant_blocks selects this stripe's grid rows
+        # for the single fused rescale, quant_ref its compacted
+        # reference slice.
+        quant=quant,
+        quant_blocks=quant_blocks,
+        quant_ref=quant_ref,
     )
 
 

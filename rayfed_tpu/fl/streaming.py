@@ -168,6 +168,8 @@ class StreamingAggregator:
         out_dtype: Any = None,
         quorum: Optional[int] = None,
         labels: Optional[Sequence[str]] = None,
+        quant: Optional[Any] = None,
+        quant_ref: Optional[Any] = None,
     ) -> None:
         if n_sources < 1:
             raise ValueError("streaming aggregation needs >= 1 source")
@@ -197,12 +199,58 @@ class StreamingAggregator:
             None if weights is None else list(self._weights)
         )
         self._allowed = allowed
-        # Output dtype of the aggregate (None = the wire dtype).  Keep
-        # f32 when the result feeds a server optimizer or error-feedback
-        # loop — re-quantizing the mean to an aggressive wire dtype is
-        # exactly the loss no residual compensates.
+        # Output dtype of the aggregate (None = the wire dtype; f32 in
+        # compressed-domain mode — integer codes make no sense as an
+        # output).  Keep f32 when the result feeds a server optimizer or
+        # error-feedback loop — re-quantizing the mean to an aggressive
+        # wire dtype is exactly the loss no residual compensates.
         self._out_dtype = None if out_dtype is None else np.dtype(out_dtype)
         self._chunk_elems = int(chunk_elems)
+        # Compressed-domain (shared-grid) mode: arriving integer codes
+        # fold into a donated i32 accumulator (widening multiply-add —
+        # exact, associative) and the ONE fused rescale happens at
+        # finalize (fedavg.finalize_packed_quantized).  ``quant`` is the
+        # round's QuantGrid; every contribution's grid fingerprint is
+        # checked against it before its bytes are trusted.
+        self._quant = quant
+        self._int_weights: Optional[List[int]] = None
+        # Delta-coded rounds: the shared reference buffer (flat f32;
+        # every controller holds it bit-identically) the finalize adds
+        # back after the single fused rescale.  A StripeAggregator gets
+        # its stripe-compacted slice.
+        self._quant_ref = None
+        # Subclasses (StripeAggregator) fold a block SUBSET of the grid;
+        # the base class folds the full buffer and cross-checks the
+        # grid's total element count + per-payload grid descriptors.
+        self._quant_full = True
+        if quant is not None:
+            if quant.mode == "delta":
+                if quant_ref is None:
+                    raise ValueError(
+                        "a mode='delta' grid needs quant_ref= (the "
+                        "round's shared reference buffer)"
+                    )
+                self._quant_ref = np.asarray(quant_ref).reshape(-1)
+            elif quant_ref is not None:
+                raise ValueError(
+                    "quant_ref only applies to mode='delta' grids"
+                )
+            from rayfed_tpu.fl.fedavg import quant_weights
+
+            if self._chunk_elems != int(quant.chunk_elems):
+                raise ValueError(
+                    f"fold grid ({self._chunk_elems} elems/block) must "
+                    f"match the quantization grid "
+                    f"({quant.chunk_elems}) — both ARE the canonical "
+                    f"packed_block_grid chunking"
+                )
+            iw, itotal = quant_weights(weights, n_sources)
+            quant.check_weight_headroom(itotal)
+            self._int_weights = iw
+            # Integer totals are exactly representable in f32 up to the
+            # headroom bound, so the float bookkeeping stays exact.
+            self._weights = [float(w) for w in iw]
+            self._total_w = float(itotal)
         self._n = n_sources
         self._streams = [_Stream() for _ in range(n_sources)]
         # Quorum (k-of-n) mode: the first k completed contributions may
@@ -249,6 +297,7 @@ class StreamingAggregator:
     def add_local(self, index: int, packed_tree: Any) -> None:
         """Feed the coordinator's own contribution (no wire hop)."""
         from rayfed_tpu.fl.compression import PackedTree
+        from rayfed_tpu.fl.quantize import QuantizedPackedTree
 
         if not isinstance(packed_tree, PackedTree):
             self.fail(
@@ -256,6 +305,34 @@ class StreamingAggregator:
                     "streaming aggregation consumes PackedTree "
                     f"contributions, got {type(packed_tree).__name__} — "
                     "produce updates with fl.compress(tree, packed=True)"
+                )
+            )
+            return
+        if self._quant is not None:
+            if not isinstance(packed_tree, QuantizedPackedTree):
+                self.fail(
+                    TypeError(
+                        "compressed-domain aggregation consumes "
+                        "QuantizedPackedTree contributions — quantize "
+                        "onto the round grid first (fl.quantize)"
+                    )
+                )
+                return
+            if packed_tree.gmeta != self._quant.meta():
+                self.fail(
+                    ValueError(
+                        f"local contribution {index} was coded on a "
+                        f"different grid (fp={packed_tree.gmeta.fp:#010x}"
+                        f" vs {self._quant.fingerprint():#010x})"
+                    )
+                )
+                return
+        elif isinstance(packed_tree, QuantizedPackedTree):
+            self.fail(
+                TypeError(
+                    "got a QuantizedPackedTree but no quant= grid — "
+                    "construct the aggregator with the round's "
+                    "QuantGrid to fold in the compressed domain"
                 )
             )
             return
@@ -441,7 +518,8 @@ class StreamingAggregator:
             import jax.numpy as jnp
 
             self._acc = jnp.zeros(
-                self._nblocks * self._chunk_elems, jnp.float32
+                self._nblocks * self._chunk_elems,
+                jnp.int32 if self._quant is not None else jnp.float32,
             )
         for s in self._streams:
             s.applied_blocks = 0
@@ -648,6 +726,24 @@ class StreamingAggregator:
                 f"buffer; split the tree into multiple packed buffers"
             )
         self._wire_dtype = s.dtype
+        if self._quant is not None:
+            if s.dtype != np.dtype(self._quant.wire_dtype):
+                raise ValueError(
+                    f"compressed-domain contribution carries "
+                    f"{s.dtype} codes, the round grid is "
+                    f"{self._quant.wire_dtype} — sender and receiver "
+                    f"disagree on the grid"
+                )
+            if (
+                self._quant_full
+                and self._total_elems != self._quant.total_elems
+            ):
+                raise ValueError(
+                    f"contribution has {self._total_elems} codes, the "
+                    f"round grid covers {self._quant.total_elems} — "
+                    f"all parties must quantize the identical packed "
+                    f"layout"
+                )
         # THE canonical grid — shared with the ring stripe schedule so
         # the fold blocks and the stripe blocks are the same blocks.
         from rayfed_tpu.fl.fedavg import packed_block_grid
@@ -656,7 +752,8 @@ class StreamingAggregator:
             self._total_elems, self._chunk_elems
         )
         self._acc = jnp.zeros(
-            self._nblocks * self._chunk_elems, jnp.float32
+            self._nblocks * self._chunk_elems,
+            jnp.int32 if self._quant is not None else jnp.float32,
         )
 
     def _avail_blocks(self, s: _Stream) -> int:
@@ -808,12 +905,29 @@ class StreamingAggregator:
                     )
             # Apply outside the lock (sinks keep landing bytes meanwhile).
             if kernel is None:
-                kernel = _accum_kernel(
-                    self._chunk_elems, "float32", str(self._wire_dtype)
-                )
+                if self._quant is not None:
+                    # The integer-accumulate path: widening i32
+                    # multiply-add of the codes (fl.fedavg, beside the
+                    # one-shot packed_quantized_sum chain it matches
+                    # exactly — integer adds are order-independent).
+                    from rayfed_tpu.fl.fedavg import (
+                        quantized_accum_kernel,
+                    )
+
+                    kernel = quantized_accum_kernel(
+                        self._chunk_elems, str(self._wire_dtype)
+                    )
+                else:
+                    kernel = _accum_kernel(
+                        self._chunk_elems, "float32", str(self._wire_dtype)
+                    )
             for i, lo, hi, src in work:
                 s = self._streams[i]
-                w = np.float32(self._weights[i])
+                w = (
+                    np.int32(self._int_weights[i])
+                    if self._int_weights is not None
+                    else np.float32(self._weights[i])
+                )
                 t0 = time.perf_counter()
                 for b in range(lo, hi):
                     self._acc = kernel(
@@ -868,18 +982,33 @@ class StreamingAggregator:
         Runs on the worker after every block folded; overridden by
         :class:`StripeAggregator` to emit a bare stripe buffer."""
         from rayfed_tpu.fl.compression import PackedTree, PackSpec
-        from rayfed_tpu.fl.fedavg import finalize_packed_stripe
 
-        out_dt = self._out_dtype or self._wire_dtype
-        out_buf = finalize_packed_stripe(
-            self._acc, self._total_w, self._total_elems, out_dt
-        )
-        out_buf.block_until_ready()
         members = (
             self._participating
             if self._participating is not None
             else list(range(self._n))
         )
+        if self._quant is not None:
+            # ONE fused rescale of the i32 code sums; every wire
+            # payload's grid descriptor is verified against the round
+            # grid first — wrong-grid codes must never rescale.
+            from rayfed_tpu.fl.fedavg import finalize_packed_quantized
+
+            self._verify_quant_members(members)
+            out_dt = self._out_dtype or np.dtype(np.float32)
+            out_buf = finalize_packed_quantized(
+                self._acc, self._quant.scales, self._quant.zps,
+                self._total_w, self._total_elems, self._chunk_elems,
+                out_dt, ref=self._quant_ref,
+            )
+        else:
+            from rayfed_tpu.fl.fedavg import finalize_packed_stripe
+
+            out_dt = self._out_dtype or self._wire_dtype
+            out_buf = finalize_packed_stripe(
+                self._acc, self._total_w, self._total_elems, out_dt
+            )
+        out_buf.block_until_ready()
         template = self._template_tree()
         passthrough = template.passthrough
         if passthrough:
@@ -901,6 +1030,33 @@ class StreamingAggregator:
         if str(out_dt) != spec.wire_dtype:
             spec = PackSpec(spec.entries, spec.treedef, np.dtype(out_dt).name)
         return PackedTree(out_buf, passthrough, spec)
+
+    def _verify_quant_members(self, members) -> None:
+        """Grid agreement check before the rescale: every member
+        payload (retained as a zero-copy view — decode is cheap) must
+        be a QuantizedPackedTree coded on exactly the round grid.
+        Local contributions were checked at ``add_local``."""
+        from rayfed_tpu.fl.quantize import QuantizedPackedTree
+
+        want = self._quant.meta()
+        for i in members:
+            s = self._streams[i]
+            if s.local_tree is not None:
+                continue
+            tree = self._tree_of(s)
+            if not isinstance(tree, QuantizedPackedTree):
+                raise TypeError(
+                    f"contribution from {self._labels[i]} is not a "
+                    f"QuantizedPackedTree — all parties must quantize "
+                    f"onto the round's shared grid"
+                )
+            if tree.gmeta != want:
+                raise ValueError(
+                    f"contribution from {self._labels[i]} was coded on "
+                    f"a different grid (fp={tree.gmeta.fp:#010x} vs "
+                    f"{want.fp:#010x}) — aborting before the rescale; "
+                    f"re-run the round on one grid"
+                )
 
     def _tree_of(self, s: _Stream):
         from rayfed_tpu.fl.compression import PackedTree
@@ -967,16 +1123,40 @@ class StripeAggregator(StreamingAggregator):
         expect_elems: Optional[int] = None,
         label: str = "stripe",
         meta_check: Optional[Any] = None,
+        quant: Optional[Any] = None,
+        quant_blocks: Optional[Sequence[int]] = None,
+        quant_ref: Optional[Any] = None,
     ) -> None:
         super().__init__(
             n_sources, weights=weights, allowed=allowed,
             chunk_elems=chunk_elems, out_dtype=out_dtype,
+            quant=quant,
+            # The stripe's compacted slice of the shared reference (the
+            # base-class size check against the FULL grid is skipped
+            # via _quant_full below).
+            quant_ref=quant_ref,
         )
         self._expect_elems = (
             None if expect_elems is None else int(expect_elems)
         )
         self._label = label
         self._meta_check = meta_check
+        # Compressed-domain stripes: the stripe's GLOBAL block indices
+        # (ascending, the compaction order) select this owner's
+        # scale/zero-point rows out of the round grid for its finalize.
+        # Stripe payloads are bare code arrays (grid agreement is the
+        # ring's rsm cross-check, not a per-payload descriptor), so the
+        # base class's full-buffer checks are skipped.
+        self._quant_full = False
+        if quant is not None and quant_blocks is None:
+            raise ValueError(
+                f"{label}: compressed-domain stripes need quant_blocks "
+                f"(the stripe's global block indices)"
+            )
+        self._quant_blocks = (
+            None if quant_blocks is None
+            else [int(b) for b in quant_blocks]
+        )
 
     def _parse_layout(self, s: _Stream) -> bool:
         already = s.data_start >= 0
@@ -1006,6 +1186,17 @@ class StripeAggregator(StreamingAggregator):
                 ValueError(
                     f"{self._label}: local stripe has {arr.size} "
                     f"elements, schedule expects {self._expect_elems}"
+                )
+            )
+            return
+        if (
+            self._quant is not None
+            and arr.dtype != np.dtype(self._quant.wire_dtype)
+        ):
+            self.fail(
+                ValueError(
+                    f"{self._label}: local stripe is {arr.dtype}, the "
+                    f"round grid codes {self._quant.wire_dtype}"
                 )
             )
             return
@@ -1040,12 +1231,34 @@ class StripeAggregator(StreamingAggregator):
     def _finalize(self):
         """Bare stripe buffer in the output dtype (host array): the
         assembly step scatters it back onto the chunk grid."""
-        from rayfed_tpu.fl.fedavg import finalize_packed_stripe
+        if self._quant is not None:
+            # The stripe's rows of the round grid: stripe block i of
+            # the compacted payload IS global block quant_blocks[i], so
+            # the per-row rescale is elementwise-identical to the
+            # whole-buffer finalize at those element positions — the
+            # keystone of ring/coordinator byte-identity, now in the
+            # compressed domain.
+            from rayfed_tpu.fl.fedavg import finalize_packed_quantized
 
-        out_dt = self._out_dtype or self._wire_dtype
-        out_buf = finalize_packed_stripe(
-            self._acc, self._total_w, self._total_elems, out_dt
-        )
+            if len(self._quant_blocks) != self._nblocks:
+                raise ValueError(
+                    f"{self._label}: {self._nblocks} folded blocks vs "
+                    f"{len(self._quant_blocks)} scheduled quant blocks"
+                )
+            scales, zps = self._quant.rows(self._quant_blocks)
+            out_dt = self._out_dtype or np.dtype(np.float32)
+            out_buf = finalize_packed_quantized(
+                self._acc, scales, zps, self._total_w,
+                self._total_elems, self._chunk_elems, out_dt,
+                ref=self._quant_ref,
+            )
+        else:
+            from rayfed_tpu.fl.fedavg import finalize_packed_stripe
+
+            out_dt = self._out_dtype or self._wire_dtype
+            out_buf = finalize_packed_stripe(
+                self._acc, self._total_w, self._total_elems, out_dt
+            )
         out_buf.block_until_ready()
         return np.asarray(out_buf)
 
@@ -1067,6 +1280,10 @@ def streaming_aggregate(
     seq_ids: Optional[Sequence[int]] = None,
     round_tag: Optional[int] = None,
     timings: Optional[Dict[str, float]] = None,
+    quant: Optional[Any] = None,
+    quant_ref: Optional[Any] = None,
+    quant_scope: Optional[str] = None,
+    quant_downlink: bool = False,
 ) -> Any:
     """FedAvg round over the streaming + delta-cache pipeline.
 
@@ -1099,6 +1316,25 @@ def streaming_aggregate(
     contribution never crosses the wire) and ``agg_s`` (wall time of the
     whole call).
 
+    ``quant``: the round's shared :class:`~rayfed_tpu.fl.quantize.
+    QuantGrid` — aggregate **in the compressed domain**: each party's
+    contribution is quantized onto the grid before the push (already-
+    quantized contributions pass through after a fingerprint check),
+    frames carry the grid descriptor (``wire.QUANT_GRID_KEY``), the
+    coordinator folds the integer codes into a donated i32 accumulator
+    and rescales ONCE at finalize.  ``quant_ref``: the round's shared
+    reference buffer (PackedTree or flat f32 buffer, bit-identical on
+    every controller — the round's starting model) for ``mode="delta"``
+    grids: parties code ``update − ref`` and the finalize adds ``ref``
+    back.  ``out_dtype`` defaults to f32 in this mode.  ``quant_scope``
+    keys the per-process error-feedback residual
+    (:func:`rayfed_tpu.fl.quantize.compressor`) — None quantizes
+    statelessly (no EF; parity tests).  ``quant_downlink``
+    re-quantizes the broadcast onto a FRESH grid derived from the
+    aggregate (carried in the payload, no negotiation needed) so the
+    downlink bytes drop too; every party — coordinator included —
+    returns the identical dequantized tree.
+
     Multi-host parties: only the party LEADER process runs the
     cross-party wire, so streaming aggregation works on the leader and
     raises ``NotImplementedError`` on non-leader coordinator processes
@@ -1122,6 +1358,24 @@ def streaming_aggregate(
                 "streaming_aggregate consumes FedObjects (party-owned "
                 f"contributions), got {type(obj).__name__}"
             )
+    if quant_downlink and quant is None:
+        raise ValueError("quant_downlink requires quant= (the grid)")
+    # The sender-side codec discipline (grid check + EF two-phase
+    # commit), shared verbatim with ring/quorum; a no-op when quant is
+    # None.
+    from rayfed_tpu.fl import quantize as qz
+
+    if quant is not None and out_dtype is None:
+        # Integer codes make no sense as an output dtype — the
+        # compressed-domain aggregate materializes in f32.
+        out_dtype = np.float32
+    codec = qz.RoundCodec(quant, quant_ref, quant_scope)
+    qref = codec.ref
+    q_descriptor = codec.descriptor
+    _to_wire = codec.to_wire
+    _quant_commit = codec.commit
+    _quant_rollback = codec.rollback
+
     # Allocated identically on every controller — the determinism
     # contract that keys the rendezvous.
     if seq_ids is None:
@@ -1142,11 +1396,19 @@ def streaming_aggregate(
         push_done: List[float] = []
         for obj in objs:
             if obj.get_party() == me:
+                local_ref = obj.get_local_ref()
+                if quant is not None:
+                    # Quantize on the resolving thread (the task-pool
+                    # worker that produced the update) — one fused
+                    # kernel, then the uint8 codes are what the delta
+                    # cache diffs and the wire ships.
+                    local_ref = local_ref.then(_to_wire)
                 push_ref = send_on_runtime(
-                    runtime, coord, obj.get_local_ref(),
+                    runtime, coord, local_ref,
                     obj.get_fed_task_id(), contrib_id,
                     stream=f"{stream}/up/{me}/{own_seq}",
                     round_tag=round_tag,
+                    quant_meta=q_descriptor,
                 )
                 if timings is not None:
                     push_ref.add_done_callback(
@@ -1154,7 +1416,22 @@ def streaming_aggregate(
                     )
                 own_seq += 1
         ref = recv_on_runtime(runtime, coord, result_id, result_id)
-        result = ref.resolve(timeout=backstop)
+        try:
+            result = ref.resolve(timeout=backstop)
+        except BaseException:
+            _quant_rollback()
+            raise
+        _quant_commit()
+        if quant is not None and isinstance(
+            result, qz.QuantizedPackedTree
+        ):
+            # Quantized downlink: decode with the grid the payload
+            # itself carries — bit-identical to the coordinator's own
+            # return value (same codes, same rescale, same shared ref).
+            result = result.dequantize(
+                np.dtype(out_dtype),
+                ref=qref if result.gmeta.mode == "delta" else None,
+            )
         if timings is not None:
             # The result broadcast only lands after the coordinator
             # folded every contribution, so the ACK timestamps are
@@ -1170,6 +1447,13 @@ def streaming_aggregate(
         weights=weights,
         allowed=runtime.cluster_config.serializing_allowed_list,
         out_dtype=out_dtype,
+        quant=quant,
+        quant_ref=qref,
+        # The fold grid IS the quantization grid (both are the
+        # canonical packed_block_grid chunking).
+        chunk_elems=(
+            quant.chunk_elems if quant is not None else DEFAULT_CHUNK_ELEMS
+        ),
     )
     pending_cancels: List[tuple] = []
     sink_entries: List[tuple] = []
@@ -1182,7 +1466,11 @@ def streaming_aggregate(
                 if exc is not None:
                     agg.fail(exc)
                 else:
-                    agg.add_local(i, ref.resolve())
+                    try:
+                        agg.add_local(i, _to_wire(ref.resolve()))
+                    # fedlint: disable=FED004 — transferred, not swallowed: fail(e) poisons every result waiter; this callback runs on the resolving task-pool thread, not the driver
+                    except BaseException as e:
+                        agg.fail(e)
 
             local_ref.add_done_callback(_feed)
         else:
@@ -1199,6 +1487,7 @@ def streaming_aggregate(
     try:
         result = agg.result(timeout=backstop)
     except BaseException as exc:
+        _quant_rollback()
         for up, down in pending_cancels:
             runtime.transport.cancel_stream(up, down)
         # Fail-fast parity with aggregate(): the peers are parked on the
@@ -1216,10 +1505,49 @@ def streaming_aggregate(
         raise
     from rayfed_tpu.proxy import send_many_on_runtime
 
+    _quant_commit()
+    wire_result = result
+    down_descriptor = None
+    if quant_downlink:
+        # Re-quantize the aggregate for the broadcast on a FRESH grid
+        # derived from the aggregate itself — the coordinator is the
+        # only sender, so the grid can follow the exact data (tiny
+        # error) and it rides the payload: receivers (and rejoiners)
+        # need no negotiation.  The coordinator returns the
+        # DEQUANTIZED codes, so every controller holds the identical
+        # bytes.  Delta rounds code (aggregate − shared ref), the form
+        # whose range the 8-bit step actually resolves.
+        if qref is not None:
+            down_src = (
+                np.asarray(result.buf).astype(np.float32) - qref
+            )
+            down_grid = qz.make_round_grid(
+                down_src, chunk_elems=quant.chunk_elems,
+                wire_dtype=quant.wire_dtype, mode="delta",
+            )
+        else:
+            down_grid = qz.make_round_grid(
+                result.buf, chunk_elems=quant.chunk_elems,
+                wire_dtype=quant.wire_dtype, mode="abs",
+            )
+        dcomp = (
+            qz.compressor(f"{quant_scope}/down")
+            if quant_scope is not None else None
+        )
+        wire_result = (
+            dcomp.quantize(result, down_grid, ref=qref)
+            if dcomp is not None
+            else qz.quantize_packed(result, down_grid, ref=qref)
+        )
+        down_descriptor = qz.grid_descriptor(down_grid)
+        result = wire_result.dequantize(np.dtype(out_dtype), ref=qref)
+        if dcomp is not None:
+            dcomp.commit()
     if others:
         send_many_on_runtime(
-            runtime, others, result, result_id, result_id,
+            runtime, others, wire_result, result_id, result_id,
             stream=f"{stream}/down", round_tag=round_tag,
+            quant_meta=down_descriptor,
         )
     if timings is not None:
         timings["push_s"] = 0.0  # own contribution never hits the wire

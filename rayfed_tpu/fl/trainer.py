@@ -27,6 +27,12 @@ from rayfed_tpu.fl.fedopt import ServerOptimizer
 
 logger = logging.getLogger(__name__)
 
+# Headroom factor for compressed-domain uplink grids (wire_quant): the
+# grid range is the previous round's aggregate delta expanded by this —
+# per-party deltas overshoot their mean, and what still clips rides the
+# error-feedback residual into the next round.
+_QUANT_DELTA_EXPAND = 4.0
+
 
 def sample_parties(
     parties: Sequence[str], sample: int, sample_seed: int, round_index: int
@@ -64,6 +70,7 @@ def run_fedavg_rounds(
     streaming_agg: bool = False,
     error_feedback: bool = False,
     wire_dtype: Any = None,
+    wire_quant: Optional[Any] = None,
     mode: str = "coordinator",
     coordinator: Optional[str] = None,
     overlap: bool = False,
@@ -130,6 +137,22 @@ def run_fedavg_rounds(
     - ``wire_dtype``: the compressed wire dtype for the driver's
       outgoing pushes (default bf16).  Pair an aggressive choice (e.g.
       ``jnp.float8_e4m3fn``) with ``error_feedback=True``.
+    - ``wire_quant``: aggregate **in the compressed domain** (``"uint8"``
+      / ``"int8"``; see :mod:`rayfed_tpu.fl.quantize` and
+      ``docs/source/compressed_aggregation.rst``).  Each round every
+      controller derives the identical shared per-block grid from the
+      previous round's observed aggregate delta, contributions are
+      coded as ``update − shared model`` on that grid (with a carried
+      error-feedback residual — the grid codec's OWN EF, which is why
+      ``error_feedback=True`` is mutually exclusive) and the
+      aggregators fold the integer codes with ONE fused rescale (+
+      reference add) at finalize — roughly half the bf16 wire bytes
+      AND half the fold's HBM traffic.  The first round has no
+      observed delta and runs unquantized (bootstrap).  Requires
+      ``compress_wire`` + ``packed_wire`` and ``streaming_agg=True``
+      or ``mode="ring"``; with streaming the result broadcast is
+      re-quantized too (fresh grid, carried in the payload).  Integral
+      non-negative ``weights`` only (example counts).
     - ``mode``: the aggregation wire topology.  ``"coordinator"`` (the
       default) funnels contributions through one party (hub-and-spoke;
       with ``streaming_agg`` they fold as they arrive).  ``"ring"``
@@ -247,6 +270,42 @@ def run_fedavg_rounds(
             "sample and weights are mutually exclusive (a weight "
             "sequence cannot align with a changing per-round subset)"
         )
+    if wire_quant is not None:
+        import numpy as _np
+
+        _qname = _np.dtype(wire_quant).name
+        if _qname not in ("uint8", "int8"):
+            raise ValueError(
+                f"wire_quant must be an 8-bit integer dtype (uint8/"
+                f"int8), got {_qname!r}"
+            )
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "wire_quant requires compress_wire=True and "
+                "packed_wire=True (the quantized unit is the packed "
+                "wire buffer)"
+            )
+        if not streaming_agg and mode != "ring":
+            raise ValueError(
+                "wire_quant requires streaming_agg=True or mode='ring' "
+                "— the compressed-domain fold lives in the streaming/"
+                "striped aggregators (fl.quantize)"
+            )
+        incompat_q = {
+            "error_feedback": error_feedback,  # quant carries its OWN
+            "aggregator": aggregator is not None,
+            "server_opt": server_opt is not None,
+            "overlap": overlap,
+            "quorum": quorum is not None,
+        }
+        bad_q = [k for k, v in incompat_q.items() if v]
+        if bad_q:
+            raise ValueError(
+                f"wire_quant is incompatible with {bad_q}: the "
+                f"grid codec carries its own error feedback, and the "
+                f"other paths have not been taught the quantized round "
+                f"shape (quorum_aggregate accepts quant= directly)"
+            )
     if streaming_agg and not (compress_wire and packed_wire):
         raise ValueError(
             "streaming_agg requires compress_wire=True and "
@@ -481,6 +540,11 @@ def run_fedavg_rounds(
         return sample_parties(parties, int(sample), sample_seed, r)
 
     current: Any = params  # tree, or FedObject in pipelined rounds
+    # Compressed-domain state: the previous round's observed aggregate
+    # delta (shared — derived from broadcast values only), the range
+    # reference for the next round's grid.  None until one round has
+    # been observed, so the first round always runs unquantized.
+    quant_prev_delta = None
 
     me = None
     if timings is not None:
@@ -555,6 +619,41 @@ def run_fedavg_rounds(
             if (error_feedback or server_opt is not None)
             else None
         )
+        # Compressed-domain round: parties code their update as a DELTA
+        # against the round's shared starting model (`current`, bit-
+        # identical on every controller) on a grid derived from the
+        # PREVIOUS round's observed aggregate delta — per-party deltas
+        # live at that scale, so the 8-bit step resolves the signal,
+        # not the ambient parameter range.  Every controller derives
+        # the identical grid from the identical shared buffers (that IS
+        # the negotiation; the fingerprint rides every quantized frame
+        # and the aggregators verify it).  The FIRST round has no
+        # observed delta yet and runs unquantized (bootstrap).
+        round_grid = None
+        round_ref = None
+        if wire_quant is not None:
+            from rayfed_tpu.fl import quantize as _qz
+            from rayfed_tpu.fl.compression import pack_tree
+
+            round_ref = _np.asarray(
+                pack_tree(current, _jnp.float32).buf
+            )
+            if quant_prev_delta is not None:
+                round_grid = _qz.make_round_grid(
+                    quant_prev_delta, wire_dtype=_qname, mode="delta",
+                    # The grid chunking must BE the fold/stripe
+                    # chunking: a ring round with an overridden
+                    # ring_chunk_elems quantizes on that same grid, or
+                    # ring_aggregate's chunk-match guard would abort
+                    # (and silently fall back) every quantized round.
+                    chunk_elems=(
+                        ring_chunk_elems if mode == "ring" else None
+                    ),
+                    # Per-party deltas overshoot the aggregate delta
+                    # (the mean averages them down) — give the grid
+                    # headroom; what still clips rides the EF residual.
+                    expand=_QUANT_DELTA_EXPAND,
+                )
         if mode == "ring":
             from rayfed_tpu.fl.ring import (
                 RING_STATS,
@@ -567,6 +666,8 @@ def run_fedavg_rounds(
                     updates, weights, stream="fedavg",
                     out_dtype=agg_out_dtype,
                     chunk_elems=ring_chunk_elems, timings=rec,
+                    quant=round_grid, quant_ref=round_ref,
+                    quant_scope="fedavg",
                 )
             except RingRoundError as e:
                 # The abort reached every controller (poison cascade +
@@ -585,6 +686,13 @@ def run_fedavg_rounds(
                     updates, weights, stream="fedavg",
                     coordinator=coord, out_dtype=agg_out_dtype,
                     timings=rec,
+                    # Same grid, same (uncommitted) residual: the
+                    # fallback re-quantizes the identical codes the
+                    # ring round would have folded.  Downlink stays
+                    # plain — this is the recovery path, keep it
+                    # simple.
+                    quant=round_grid, quant_ref=round_ref,
+                    quant_scope="fedavg",
                 )
         elif streaming_agg:
             from rayfed_tpu.fl.streaming import streaming_aggregate
@@ -594,6 +702,11 @@ def run_fedavg_rounds(
                 coordinator=coord,
                 out_dtype=agg_out_dtype,
                 timings=rec,
+                quant=round_grid, quant_ref=round_ref,
+                quant_scope="fedavg",
+                # Quantize the result broadcast too: the downlink is
+                # the other half of the round's bytes.
+                quant_downlink=round_grid is not None,
             )
         else:
             t_a0 = _time.perf_counter() if rec is not None else 0.0
@@ -602,6 +715,13 @@ def run_fedavg_rounds(
             )
             if rec is not None:
                 rec["agg_s"] = _time.perf_counter() - t_a0
+        if wire_quant is not None:
+            # What the grid must cover next round: how far the global
+            # model just moved, per block.  Derived from broadcast
+            # values only, so it is bit-identical on every controller.
+            quant_prev_delta = (
+                _np.asarray(avg.buf).astype(_np.float32) - round_ref
+            )
         if compress_wire:
             avg = decompress(avg)
         if server_opt is not None:

@@ -23,6 +23,7 @@ def send_on_runtime(
     stream: Any = None,
     round_tag: Any = None,
     epoch_tag: Any = None,
+    quant_meta: Any = None,
 ) -> LocalRef:
     """``stream``: stable stream name enabling the transport's per-peer
     delta cache (ship only changed chunks — see TransportClient).
@@ -30,7 +31,9 @@ def send_on_runtime(
     (``wire.ROUND_TAG_KEY``) so in-flight pipelined rounds stay
     attributable — see :meth:`TransportManager.send`.  ``epoch_tag``:
     roster epoch stamped into the metadata (``wire.EPOCH_TAG_KEY``;
-    cross-epoch frames are rejected loudly by the receiver)."""
+    cross-epoch frames are rejected loudly by the receiver).
+    ``quant_meta``: shared-quantization-grid descriptor stamped into the
+    metadata (``wire.QUANT_GRID_KEY``) for compressed-domain payloads."""
     if runtime.send_proxy is None:
         raise RuntimeError("transport not started; call fed.init() first")
     result_ref = runtime.send_proxy.send(
@@ -41,6 +44,7 @@ def send_on_runtime(
         stream=stream,
         round_tag=round_tag,
         epoch_tag=epoch_tag,
+        quant_meta=quant_meta,
     )
     if runtime.cleanup_manager is not None:
         runtime.cleanup_manager.push_to_sending(result_ref)
@@ -56,6 +60,7 @@ def send_many_on_runtime(
     stream: Any = None,
     round_tag: Any = None,
     epoch_tag: Any = None,
+    quant_meta: Any = None,
 ) -> dict:
     """Broadcast fan-out: ONE payload encode shared by every destination.
 
@@ -75,6 +80,7 @@ def send_many_on_runtime(
         stream=stream,
         round_tag=round_tag,
         epoch_tag=epoch_tag,
+        quant_meta=quant_meta,
     )
     if runtime.cleanup_manager is not None:
         for ref in refs.values():

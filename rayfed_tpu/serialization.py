@@ -32,6 +32,10 @@ _INTERNAL_ALLOWED = {
     ("rayfed_tpu.transport.wire", "_LeafSlot"),
     ("rayfed_tpu.fl.compression", "PackedTree"),
     ("rayfed_tpu.fl.compression", "PackSpec"),
+    # Shared-grid integer wire form (compressed-domain aggregation):
+    # the coded skeleton carries the class + its static grid descriptor.
+    ("rayfed_tpu.fl.quantize", "QuantizedPackedTree"),
+    ("rayfed_tpu.fl.quantize", "QuantMeta"),
     ("jax._src.tree_util", "default_registry"),
 }
 

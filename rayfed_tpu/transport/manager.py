@@ -803,6 +803,7 @@ class TransportManager:
         stream: Optional[str] = None,
         round_tag: Optional[int] = None,
         epoch_tag: Optional[int] = None,
+        quant_meta: Optional[Dict[str, Any]] = None,
     ) -> LocalRef:
         """Owner-initiated push.  Returns a LocalRef resolving to True/False.
 
@@ -826,10 +827,16 @@ class TransportManager:
         (``wire.EPOCH_TAG_KEY``) — a receiver whose roster has advanced
         rejects the frame loudly instead of parking stale bytes (see
         :class:`RosterState`).
+
+        ``quant_meta``: compact shared-quantization-grid descriptor
+        stamped into the frame metadata (``wire.QUANT_GRID_KEY``,
+        JSON-encoded) when the payload is integer codes on the round's
+        shared grid — see :mod:`rayfed_tpu.fl.quantize`.
         """
         return self.send_many(
             [dest_party], data, upstream_seq_id, downstream_seq_id,
             stream=stream, round_tag=round_tag, epoch_tag=epoch_tag,
+            quant_meta=quant_meta,
         )[dest_party]
 
     def send_many(
@@ -841,6 +848,7 @@ class TransportManager:
         stream: Optional[str] = None,
         round_tag: Optional[int] = None,
         epoch_tag: Optional[int] = None,
+        quant_meta: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, LocalRef]:
         """Fan one value out to N parties — encode once, send concurrently.
 
@@ -863,6 +871,12 @@ class TransportManager:
             send_meta[wire.ROUND_TAG_KEY] = str(round_tag)
         if epoch_tag is not None:
             send_meta[wire.EPOCH_TAG_KEY] = str(epoch_tag)
+        if quant_meta is not None:
+            import json as _json
+
+            send_meta[wire.QUANT_GRID_KEY] = _json.dumps(
+                quant_meta, separators=(",", ":"), sort_keys=True
+            )
         send_meta = send_meta or None
 
         def _poison_all(exc: BaseException) -> None:

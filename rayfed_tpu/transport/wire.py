@@ -136,6 +136,20 @@ ROUND_TAG_KEY = "rnd"
 # tool/check_wire_format.py.
 EPOCH_TAG_KEY = "ep"
 
+# Metadata key carrying the round's shared QUANTIZATION-GRID descriptor
+# (compressed-domain aggregation, fl.quantize): frames whose payload is
+# integer codes on the round's shared grid are stamped with the compact
+# JSON descriptor produced by ``fl.quantize.grid_descriptor`` —
+# {version, fingerprint, block count, chunk elems, total elems, wire
+# dtype} — so receivers and logs can attribute the frame to its grid
+# without decoding the payload, and a cross-grid push is diagnosable at
+# the transport layer (the fold layer independently re-verifies the
+# fingerprint before any rescale).  Same meta-dict transport as
+# ROUND_TAG_KEY: no frame-layout change, but the key name AND the
+# descriptor schema are cross-party contracts — both fingerprinted by
+# tool/check_wire_format.py.
+QUANT_GRID_KEY = "qg"
+
 
 def pack_frame(
     msg_type: int,

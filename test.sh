@@ -46,7 +46,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # least half the same-box push capability — the r05 send-path gap was
 # 0.24), send_vs_read_wall_ratio <= 1.5 (no full-payload
 # serialization barrier in front of the coordinator's broadcast; the
-# r05 send/read imbalance was 2.7x), and the CHAOS gate: under a
+# r05 send/read imbalance was 2.7x), the COMPRESSED-DOMAIN gates:
+# compressed_bytes_on_wire_frac <= 0.55 (shared-grid uint8 rounds vs
+# the bf16 path, both directions), compressed_fold_speedup >= 1.0
+# (the donated-i32 integer fold must beat dequantize-first),
+# compressed_agg_bitexact (streamed integer fold == one-shot
+# packed_quantized_sum) and compressed_loss_ratio <= 1.05 (8-bit+EF
+# converges with f32 — equal converged accuracy), and the CHAOS gate:
+# under a
 # seeded schedule injecting 1 straggler past the round deadline, 1
 # hard party crash at N=4, AND a hard kill of the COORDINATOR between
 # round 2's quorum cutoff and its broadcast, run_fedavg_rounds(

@@ -541,6 +541,52 @@ def _run_trainer_streaming(party, cluster):
     )
     last = fed.get(trainers["alice"].loss.remote(final))
     assert last < first, (first, last)
+
+    # --- compressed-domain rounds over the same cluster (same child:
+    # startup dominates, so the wire_quant e2e rides along) ------------
+    from rayfed_tpu.fl import quantize as qz
+
+    final_q = run_fedavg_rounds(
+        trainers, params, rounds=4,
+        compress_wire=True, packed_wire=True, streaming_agg=True,
+        wire_quant="uint8",
+    )
+    last_q = fed.get(trainers["alice"].loss.remote(final_q))
+    assert last_q < first, (first, last_q)
+    # Equal converged trajectory within the 8-bit+EF budget: the
+    # quantized loop must land in the same neighborhood as bf16.
+    assert abs(last_q - last) < 0.05 * max(first - last, 1e-6), (
+        last, last_q,
+    )
+    # The round loop committed per-round EF residuals for the uplink.
+    assert qz.compressor("fedavg").residual is not None
+
+    # Quantized streaming parity against the one-shot compressed
+    # reduce + re-quantized downlink (stateless scope => reproducible).
+    ref_buf = np.asarray(make_update(1).buf, dtype=np.float32)
+    grid = qz.make_round_grid(
+        0.01 * np.ones_like(ref_buf), mode="delta", expand=4.0
+    )
+    got_q = streaming_aggregate(
+        objs, stream="test-qsagg", quant=grid, quant_ref=ref_buf,
+        quant_downlink=True,
+    )
+    qts = [
+        qz.quantize_packed(make_update(s), grid, ref=ref_buf)
+        for s in (1, 2)
+    ]
+    want_q = F.packed_quantized_sum(qts, ref=ref_buf)
+    down = qz.make_round_grid(
+        np.asarray(want_q.buf, dtype=np.float32) - ref_buf,
+        chunk_elems=grid.chunk_elems, wire_dtype=grid.wire_dtype,
+        mode="delta",
+    )
+    expect_q = qz.quantize_packed(want_q, down, ref=ref_buf).dequantize(
+        np.float32, ref=ref_buf
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_q.buf), np.asarray(expect_q.buf)
+    )
     fed.shutdown()
 
 

@@ -126,6 +126,25 @@ def compute_fingerprint() -> str:
         stripe=1, n_stripes=4, nblocks=9, total_elems=1 << 21,
         dtype="bfloat16", phase="rs",
     )
+    # Compressed-domain (v2) shape: "rs" stripes of a quantized round
+    # additionally carry the shared grid's fingerprint — both shapes
+    # are contract, so both are fingerprinted.
+    stripe_manifest_quant = ring.make_stripe_meta(
+        stripe=1, n_stripes=4, nblocks=9, total_elems=1 << 21,
+        dtype="uint8", phase="rs", qgrid_fp=12345,
+    )
+
+    # Shared quantization grid (compressed-domain aggregation,
+    # fl.quantize): the compact descriptor rides the frame metadata
+    # under wire.QUANT_GRID_KEY, and both ends must agree on its schema
+    # AND on the quantization semantics version.
+    from rayfed_tpu.fl import quantize as qz
+
+    grid = qz.make_round_grid(
+        np.linspace(-1.0, 1.0, 4096, dtype=np.float32),
+        chunk_elems=1024,
+    )
+    quant_grid_descriptor = qz.grid_descriptor(grid)
 
     material = json.dumps(
         {
@@ -154,7 +173,16 @@ def compute_fingerprint() -> str:
             # tag — no frame-layout change, but a cross-party contract.
             "epoch_tag_key": wire.EPOCH_TAG_KEY,
             "ring_stripe_schema": _schema(stripe_manifest),
+            "ring_stripe_quant_schema": _schema(stripe_manifest_quant),
             "ring_stripe_version": ring.RING_STRIPE_VERSION,
+            # Compressed-domain aggregation: the metadata key carrying
+            # the round's shared quantization-grid descriptor, the
+            # descriptor's schema, and the grid semantics version (the
+            # transfer function integer codes are decoded with).  Key
+            # set changes re-pin the lock via frame_metadata_keys too.
+            "quant_grid_key": wire.QUANT_GRID_KEY,
+            "quant_grid_schema": _schema(quant_grid_descriptor),
+            "quant_grid_version": qz.QUANT_GRID_VERSION,
             # Frame-metadata key constants declared in wire.py (*_KEY),
             # extracted by fedlint's FED006 machinery — the same pass
             # that forbids string-literal metadata keys in transport/
