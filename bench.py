@@ -987,6 +987,224 @@ def _run_compressed_agg_bench(_party: str, result_q) -> None:
     )
 
 
+def _run_secagg_bench(_party: str, result_q) -> None:
+    """Masked (secure-aggregation) rounds vs plain quantized rounds —
+    fl.secagg over the compressed-domain fold (fl.quantize).
+
+    Same in-process 4-party TransportManager shape as the compressed
+    bench; key agreement rides the real HELLO handshake (one ping per
+    pair).  Each round is the realistic federated shape — every party
+    runs a small jitted local step, quantizes its update onto the
+    round's shared grid, pushes to the coordinator, and the integer
+    fold + ONE rescale finalizes — timed twice: plain codes (uint8)
+    and masked codes (``w·q + pairwise masks``, i32, unit-weight fold;
+    mask keystreams prefetch on a background thread while the local
+    step runs, exactly as the round driver does).
+
+    Gates (test.sh):
+
+    - ``secagg_bitexact`` — the masked round's aggregate bytes EQUAL
+      the plain round's over the same contributions (the masks cancel
+      exactly, not approximately).
+    - ``secagg_overhead_frac <= 0.05`` — masking adds at most 5% to
+      the round wall (masks ship zero bytes; the mask PRG + the i32
+      code widening are the only costs, and the PRG hides under the
+      local step).
+
+    ``secagg_mask_gen_ms`` reports the raw (unhidden) keystream cost
+    so the overlap can never silently mask a PRG regression.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    os.environ.setdefault("RAYFED_SECAGG_GROUP_KEY", "bench-secagg-key")
+
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.fl import fedavg as fl_fedavg
+    from rayfed_tpu.fl import quantize as qz
+    from rayfed_tpu.fl import secagg as sa
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+    from rayfed_tpu.transport.manager import TransportManager
+
+    parties = ("alice", "bob", "carol", "dave")
+    ports = {p: 13180 + i for i, p in enumerate(parties)}
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                p: PartyConfig.from_dict({"address": f"127.0.0.1:{ports[p]}"})
+                for p in parties
+            },
+            current_party=party,
+        )
+        return TransportManager(
+            cc,
+            JobConfig(device_put_received=False, zero_copy_host_arrays=True),
+        )
+
+    mgrs = {p: mk(p) for p in parties}
+    for m in mgrs.values():
+        m.start()
+    # Key agreement over the real HELLO handshake: one ping per pair.
+    for p in parties:
+        mgrs[p].ensure_secagg_peer_keys(parties)
+
+    n = 1 << 16
+    ce = 1 << 16
+    ref = np.linspace(-0.5, 0.5, n, dtype=np.float32)
+    tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+    rng = np.random.default_rng(0)
+    grid = qz.make_round_grid(
+        (1e-3 * rng.standard_normal(n)).astype(np.float32),
+        mode="delta", expand=4.0, chunk_elems=ce,
+    )
+    weights = [2.0, 1.0, 3.0, 1.0]
+    wmap = dict(zip(parties, weights))
+    peers = [p for p in parties if p != "alice"]
+
+    # The local step: a fixed jitted matmul chain per party per round —
+    # the compute share every real round carries, and the window the
+    # mask PRG prefetch hides under.
+    # ~65 ms of jitted compute per party — a modest stand-in for the
+    # local train step every real round carries (the keystream prefetch
+    # thread interleaves with it: XLA releases the GIL, so the numpy
+    # PRG genuinely overlaps; without ANY local compute a federated
+    # round is pure transport, which no deployment is).
+    @jax.jit
+    def _local_step(x):
+        for _ in range(32):
+            x = jnp.tanh(x @ x) + 0.1
+        return x
+
+    step_x = jnp.ones((512, 512), jnp.float32) * 0.01
+
+    def contribution(i: int, r: int):
+        return fl_comp.PackedTree(
+            ref + (1e-3 * np.random.default_rng(100 * r + i)
+                   .standard_normal(n)).astype(np.float32),
+            tmpl.passthrough, tmpl.spec,
+        )
+
+    mask_gen_s = [0.0]
+
+    def do_round(r: int, masked: bool):
+        t0 = time.perf_counter()
+        maskers = {}
+        if masked:
+            for p in parties:
+                maskers[p] = sa.RoundMasker(
+                    mgrs[p].secagg_keys, p,
+                    [q for q in parties if q != p],
+                    session="bench", stream="sab", round_index=r,
+                    weight=int(wmap[p]),
+                )
+                # Prefetch the keystream under the local step, exactly
+                # as the round driver does.
+                maskers[p].prefetch(n)
+        wires = {}
+        for i, p in enumerate(parties):
+            jax.block_until_ready(_local_step(step_x))  # the local step
+            up = contribution(i, r)
+            if masked:
+                wires[p] = sa.MaskedRoundCodec(
+                    grid, ref, None, maskers[p]
+                ).to_wire(up)
+            else:
+                wires[p] = qz.quantize_packed(up, grid, ref=ref)
+        gd = qz.grid_descriptor(grid)
+        tag = "m" if masked else "q"
+        send_refs = [
+            mgrs[p].send("alice", wires[p], f"sab-{tag}-{r}-{p}", "0",
+                         quant_meta=gd)
+            for p in peers
+        ]
+        agg = StreamingAggregator(
+            len(parties), weights=weights, quant=grid, quant_ref=ref,
+            chunk_elems=ce, masked=masked, labels=list(parties),
+        )
+        for i, p in enumerate(peers):
+            mgrs["alice"].recv_stream(p, f"sab-{tag}-{r}-{p}", "0",
+                                      agg.sink(i + 1))
+        agg.add_local(0, wires["alice"])
+        result = agg.result(timeout=300)
+        bcast = mgrs["alice"].send_many(peers, result, f"sabb-{tag}-{r}", "0")
+        for p in peers:
+            mgrs[p].recv("alice", f"sabb-{tag}-{r}", "0").resolve(timeout=300)
+        for ref_ in send_refs + list(bcast.values()):
+            if not ref_.resolve(timeout=300):
+                raise RuntimeError("secagg bench round send failed")
+        return time.perf_counter() - t0, result
+
+    # Raw (unhidden) keystream cost, reported alongside: one party's
+    # net mask for one round, generated synchronously.
+    t0 = time.perf_counter()
+    probe = sa.RoundMasker(
+        mgrs["alice"].secagg_keys, "alice", list(peers),
+        session="probe", stream="sab", round_index=0, weight=1,
+    )
+    probe.net_mask(n)
+    mask_gen_s[0] = time.perf_counter() - t0
+
+    do_round(90, False)  # warm both stacks (compiles, delta caches)
+    do_round(91, True)
+    rounds = 4
+    plain_walls, masked_walls = [], []
+    plain_res = masked_res = None
+    for r in range(rounds):
+        w_p, plain_res = do_round(r, False)
+        w_m, masked_res = do_round(r, True)
+        plain_walls.append(w_p)
+        masked_walls.append(w_m)
+    # Same contributions each (r, masked) pair → the aggregates must be
+    # BYTE-identical: the pairwise masks cancel exactly.
+    bitexact = bool(np.array_equal(
+        np.asarray(plain_res.buf), np.asarray(masked_res.buf)
+    ))
+    from rayfed_tpu.fl.secagg import SECAGG_STATS
+
+    stats = {p: mgrs[p].get_stats()["secagg"] for p in parties}
+    for m in mgrs.values():
+        m.stop()
+    plain_s = min(plain_walls)
+    masked_s = min(masked_walls)
+    result_q.put((
+        "secagg",
+        {
+            "plain_round_ms": plain_s * 1e3,
+            "masked_round_ms": masked_s * 1e3,
+            "overhead_frac": max(0.0, masked_s / plain_s - 1.0),
+            "bitexact": bitexact,
+            "mask_gen_ms": mask_gen_s[0] * 1e3,
+            "keygen_ms": float(SECAGG_STATS["keygen_ms"]),
+            "suite": stats["alice"]["kex"] + "/" + stats["alice"]["prg"],
+            "peers_keyed": min(
+                len(stats[p]["peers"]) for p in parties
+            ),
+        },
+    ))
+
+
+def _fill_secagg_extra(extra: dict, s: dict) -> None:
+    extra["secagg_bitexact"] = s["bitexact"]
+    extra["secagg_overhead_frac"] = round(s["overhead_frac"], 3)
+    extra["secagg_round_ms"] = round(s["masked_round_ms"], 1)
+    extra["secagg_plain_round_ms"] = round(s["plain_round_ms"], 1)
+    extra["secagg_mask_gen_ms"] = round(s["mask_gen_ms"], 2)
+    extra["secagg_keygen_ms"] = round(s["keygen_ms"], 2)
+    extra["secagg_suite"] = s["suite"]
+    extra["secagg_peers_keyed"] = s["peers_keyed"]
+    _log(
+        f"  secagg: masked round {s['masked_round_ms']:.0f} ms vs plain "
+        f"quantized {s['plain_round_ms']:.0f} ms "
+        f"({s['overhead_frac']:.1%} overhead; raw keystream "
+        f"{s['mask_gen_ms']:.1f} ms/party hidden under the local step), "
+        f"suite {s['suite']}, masked bytes "
+        f"{'IDENTICAL' if s['bitexact'] else 'DIVERGED'} to unmasked"
+    )
+
+
 def _fill_compressed_extra(extra: dict, s: dict) -> None:
     extra["compressed_bytes_on_wire_frac"] = round(s["bytes_frac"], 3)
     extra["compressed_agg_GBps"] = round(s["gbps"], 3)
@@ -3222,6 +3440,11 @@ def main() -> None:
             ca = _one_child("_run_compressed_agg_bench", ndev=1,
                             timeout=420)
             _fill_compressed_extra(extra, ca)
+        with _section(extra, "secagg"):
+            _log("secure-aggregation smoke (pairwise-masked integer "
+                 "folds vs plain quantized rounds, 4 parties)...")
+            sg = _one_child("_run_secagg_bench", ndev=1, timeout=420)
+            _fill_secagg_extra(extra, sg)
         with _section(extra, "chaos"):
             _log("chaos smoke (quorum=2 rounds under injected straggler "
                  "+ party crash + coordinator kill mid-round, 4 "
@@ -3246,6 +3469,7 @@ def main() -> None:
             or "overlap_error" in extra
             or "send_path_error" in extra
             or "compressed_agg_error" in extra
+            or "secagg_error" in extra
             or "chaos_error" in extra
         ):
             raise SystemExit(1)
@@ -3287,6 +3511,26 @@ def main() -> None:
                 f"compressed-agg smoke gate FAILED: "
                 f"compressed_loss_ratio={clr} (8-bit+EF must converge "
                 f"with f32 on the quadratic, ratio <= 1.05)"
+            )
+            raise SystemExit(1)
+        # CI gates (test.sh): secure aggregation must be exact and
+        # near-free — (1) the masked round's aggregate BYTE-identical
+        # to the plain quantized round's (pairwise masks cancel in the
+        # integer ring, not approximately), (2) masking adds at most 5%
+        # to the round wall (masks ship zero bytes; the keystream
+        # prefetch hides under the local step).
+        if not extra.get("secagg_bitexact"):
+            _log(
+                "secagg smoke gate FAILED: masked aggregate != plain "
+                "quantized aggregate (the masks must cancel bit-exactly)"
+            )
+            raise SystemExit(1)
+        sof = extra.get("secagg_overhead_frac")
+        if sof is None or sof > 0.05:
+            _log(
+                f"secagg smoke gate FAILED: secagg_overhead_frac={sof} "
+                f"(masked rounds must cost <= 5% over plain quantized "
+                f"rounds)"
             )
             raise SystemExit(1)
         # CI gate (test.sh): the ring must actually de-bottleneck the

@@ -120,6 +120,13 @@ ENV_VAR = "RAYFED_CHAOS"
 HOOKS = (
     "connect", "send", "frame", "wire", "server_frame", "round",
     "announce", "republish",
+    # Secure aggregation (fl.secagg): fires on the quorum coordinator
+    # between the cutoff pinning the member set and the mask-recovery
+    # announcement — killing it there leaves the survivors parked on
+    # the recovery round trip with no poison coming, the nastiest
+    # secure-round window (only failover can finish the round, and the
+    # successor must re-run recovery on its own stream).
+    "secagg_recovery",
 )
 
 _OPS = (

@@ -620,6 +620,26 @@ class MultiHostTransport:
             return self._inner.drain_membership_requests()
         return []
 
+    @property
+    def secagg_keys(self):
+        """Secure-aggregation key agreement (transport/secagg.py) —
+        leader-only, like every other cross-party plane: the leader's
+        HELLO handshakes carry the party's key.  None on non-leaders;
+        the fl.secagg entry points fail loudly on it (masked rounds are
+        leader-driven, like streaming aggregation)."""
+        if self._inner is not None:
+            return self._inner.secagg_keys
+        return None
+
+    def ensure_secagg_peer_keys(self, parties, timeout_s: float = 30.0):
+        if self._inner is None:
+            raise NotImplementedError(
+                "secure aggregation is leader-driven: non-leader "
+                "processes of a multi-host party have no cross-party "
+                "wire to agree keys over"
+            )
+        return self._inner.ensure_secagg_peer_keys(parties, timeout_s)
+
     def set_max_message_size(self, max_bytes: int) -> None:
         """Runtime message-size cap mutation — NOT supported for
         multi-host parties: the mutation only reaches this process's

@@ -11,7 +11,9 @@ Here the common algorithms ship with the framework:
   gradients pushed back (BASELINE.md config #5).
 - :mod:`fedopt` — server optimizers (FedAvgM/FedAdam/FedYogi) over the
   round's pseudo-gradient, and the FedProx client loss wrapper.
-- :mod:`secure` — pairwise-masked secure aggregation (sum-only reveal).
+- :mod:`secagg` — secure aggregation: pairwise-masked integer folds
+  (sum-only reveal) with HELLO-handshake key agreement and
+  quorum-dropout mask recovery (``run_fedavg_rounds(secure_agg=True)``).
 - :mod:`dp` — differential privacy: global-norm clipping + Gaussian
   noise on outgoing updates.
 - :mod:`robust` — Byzantine-robust aggregation (coordinate median,
@@ -66,7 +68,14 @@ from rayfed_tpu.fl.robust import (
     tree_median,
     tree_trimmed_mean,
 )
-from rayfed_tpu.fl.secure import mask_update, unmask_sum
+from rayfed_tpu.fl.secagg import (
+    MaskedCodeTree,
+    MaskedRoundCodec,
+    RoundMasker,
+    SecAggError,
+    mask_update,
+    unmask_sum,
+)
 from rayfed_tpu.fl.split import SplitTrainer
 from rayfed_tpu.fl.trainer import run_fedavg_rounds
 
@@ -105,6 +114,10 @@ __all__ = [
     "fedprox_loss",
     "mask_update",
     "unmask_sum",
+    "MaskedCodeTree",
+    "MaskedRoundCodec",
+    "RoundMasker",
+    "SecAggError",
     "privatize",
     "clip_by_global_norm",
     "run_fedavg_rounds",

@@ -8,16 +8,22 @@ composition over rounds) is deployment policy and depends on the
 sampling regime; this module provides the mechanism, applied
 identically by every party to its own update before the push.
 
-Composes with :mod:`rayfed_tpu.fl.secure`: clip first (secure
+Composes with :mod:`rayfed_tpu.fl.secagg`: clip first (secure
 aggregation needs bounded values anyway), noise, then mask — the server
-only ever sees the noised sum.  Mind the ranges when composing:
-``mask_update``'s fixed-point encode re-clips per-coordinate at its
-``clip`` (default ±8), and Gaussian noise with σ = noise_multiplier ·
-clip_norm can exceed that range and be truncated, biasing the sum and
-weakening the stated DP mechanism.  Use :func:`secure_clip_for` to pick
-a safe fixed-point range (it is validated by
-:func:`check_secure_composition`, which :func:`privatize` cannot run
-for you because it never sees the fixed-point clip).
+only ever sees the noised sum.  The transport rounds
+(``run_fedavg_rounds(secure_agg=True)``) mask in the shared-grid
+integer domain, where headroom is the grid's own concern: the clipped
+mass of an out-of-range noised update rides the error-feedback
+residual, and the i32 overflow guard is
+:meth:`~rayfed_tpu.fl.quantize.QuantGrid.check_weight_headroom`.  The
+range discipline below applies to the IN-PROCESS fixed-point primitive
+(:func:`rayfed_tpu.fl.secagg.mask_update`): its encode re-clips
+per-coordinate at its ``clip`` (default ±8), and Gaussian noise with
+σ = noise_multiplier · clip_norm can exceed that range and be
+truncated, biasing the sum and weakening the stated DP mechanism.  Use
+:func:`secure_clip_for` to pick a safe fixed-point range (it is
+validated by :func:`check_secure_composition`, which :func:`privatize`
+cannot run for you because it never sees the fixed-point clip).
 
 All jit-compiled pytree arithmetic; noise is drawn on-device from a
 party-held PRNG key.
@@ -62,7 +68,7 @@ def clip_by_global_norm(tree: Any, clip_norm: float) -> Tuple[Any, jax.Array]:
 def secure_clip_for(
     *, clip_norm: float, noise_multiplier: float, tail_sds: float = 6.0
 ) -> float:
-    """Fixed-point ``clip`` for ``fl.secure.mask_update`` after ``privatize``.
+    """Fixed-point ``clip`` for ``fl.secagg.mask_update`` after ``privatize``.
 
     A privatized coordinate is bounded by ``clip_norm`` (global-L2
     clipping bounds every coordinate) plus Gaussian noise of
@@ -85,7 +91,7 @@ def check_secure_composition(
     """Raise if ``mask_update(clip=secure_clip)`` would truncate DP noise.
 
     Call with the values you pass to :func:`privatize` and to
-    ``fl.secure.mask_update``; raises ``ValueError`` when the
+    ``fl.secagg.mask_update``; raises ``ValueError`` when the
     fixed-point range leaves fewer than ``tail_sds`` noise standard
     deviations of headroom above ``clip_norm``.
     """

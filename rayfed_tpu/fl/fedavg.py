@@ -235,6 +235,54 @@ def quantized_accum_kernel(chunk_elems: int, wire_dtype: str):
 
 
 @functools.lru_cache(maxsize=None)
+def masked_code_kernel():
+    """ONE fused weight-and-mask step of a secure round
+    (fl.secagg): ``bitcast_i32(u32(w·q) + net_mask)`` over the whole
+    code buffer.
+
+    The sibling of :func:`quantized_accum_kernel` on the SENDER side:
+    the grid codes widen to i32, fold in this party's own integral
+    weight (pairwise masks only cancel at unit fold weight — ``w_i·m −
+    w_j·m ≠ 0``), and add the party's net pairwise mask in uint32, whose
+    arithmetic wraps mod 2³² by definition (the masked value must be
+    uniform over the ring the sum lives in).  The receiver folds the
+    resulting i32 codes through the UNCHANGED
+    :func:`quantized_accum_kernel` at weight 1 — i32 addition wraps the
+    same ring — so after every pair mask met its negative the
+    accumulator holds exactly ``Σ w_i·q_i`` and the finalize emits the
+    unmasked round's bytes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _mask(q, w, net_mask_u32):
+        v = w * q.astype(jnp.int32)  # |w·q| ≤ qabs_max·W: exact in i32
+        return jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(v, jnp.uint32) + net_mask_u32,
+            jnp.int32,
+        )
+
+    return _mask
+
+
+@functools.lru_cache(maxsize=None)
+def masked_correction_kernel():
+    """Subtract a dropout round's orphaned-mask correction
+    (``fl.secagg.mask_correction``) from the donated i32 accumulator —
+    uint32 bitcast arithmetic, mod 2³² like every masked step."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _sub(acc, corr_u32):
+        a = jax.lax.bitcast_convert_type(acc, jnp.uint32)
+        return jax.lax.bitcast_convert_type(a - corr_u32, jnp.int32)
+
+    return _sub
+
+
+@functools.lru_cache(maxsize=None)
 def _quant_reduce_jit(nblocks: int, chunk_elems: int):
     """One-shot integer reduce: widen + weighted-add chain over the
     packed code buffers, padded onto the canonical block grid (the

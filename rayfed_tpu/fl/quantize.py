@@ -88,6 +88,14 @@ from rayfed_tpu.fl.compression import PackedTree, PackSpec
 # without a bump fails the build like any wire drift.
 QUANT_GRID_VERSION = 1
 
+# Headroom factor for compressed-domain uplink grids: the grid range is
+# the previous round's aggregate delta expanded by this — per-party
+# deltas overshoot their mean (the mean averages them down), and what
+# still clips rides the error-feedback residual into the next round.
+# Shared by every driver loop (classic streaming, ring, quorum) so the
+# grids they derive from identical reference buffers stay bit-identical.
+QUANT_DELTA_EXPAND = 4.0
+
 # Integer wire dtypes the grid supports → (qmin, qmax).
 _QRANGES: Dict[str, Tuple[int, int]] = {
     "uint8": (0, 255),
@@ -728,6 +736,52 @@ class RoundCodec:
     def rollback(self) -> None:
         if self.grid is not None and self._scope is not None:
             compressor(self._scope).rollback()
+
+
+def quantize_downlink(
+    result: Any,
+    grid: QuantGrid,
+    ref: Optional[np.ndarray],
+    scope: Optional[str],
+    out_dtype: Any = np.float32,
+) -> Tuple[QuantizedPackedTree, Any, Dict[str, Any]]:
+    """Re-quantize a round aggregate for the result broadcast.
+
+    The coordinator is the only sender, so the downlink grid can follow
+    the exact data (FRESH grid from the aggregate itself, tiny error)
+    and it rides the payload — receivers and rejoiners need no
+    negotiation.  Delta rounds code ``aggregate − shared ref``, the form
+    whose range the 8-bit step actually resolves.  Returns ``(wire
+    form, dequantized aggregate, grid descriptor)`` — the coordinator
+    returns the DEQUANTIZED codes so every controller holds the
+    identical bytes.  ONE producer shared by ``streaming_aggregate``
+    and ``quorum_aggregate``: the quantized-quorum and quantized-
+    streaming downlinks are byte-identical by construction, not by
+    parallel maintenance.  ``scope`` keys the downlink's own
+    error-feedback residual (``{scope}/down``); None quantizes
+    statelessly.
+    """
+    if ref is not None:
+        down_src = np.asarray(result.buf).astype(np.float32) - ref
+        down_grid = make_round_grid(
+            down_src, chunk_elems=grid.chunk_elems,
+            wire_dtype=grid.wire_dtype, mode="delta",
+        )
+    else:
+        down_grid = make_round_grid(
+            result.buf, chunk_elems=grid.chunk_elems,
+            wire_dtype=grid.wire_dtype, mode="abs",
+        )
+    dcomp = compressor(f"{scope}/down") if scope is not None else None
+    wire_result = (
+        dcomp.quantize(result, down_grid, ref=ref)
+        if dcomp is not None
+        else quantize_packed(result, down_grid, ref=ref)
+    )
+    decoded = wire_result.dequantize(np.dtype(out_dtype), ref=ref)
+    if dcomp is not None:
+        dcomp.commit()
+    return wire_result, decoded, grid_descriptor(down_grid)
 
 
 # Per-process compressor registry, keyed by stream scope (one EF state

@@ -149,6 +149,7 @@ def quorum_aggregate(
     quant: Optional[Any] = None,
     quant_ref: Optional[Any] = None,
     quant_scope: Optional[str] = None,
+    secagg: Optional[Any] = None,
 ) -> QuorumRoundOutcome:
     """One k-of-n streaming round over the coordinator topology.
 
@@ -173,10 +174,30 @@ def quorum_aggregate(
     subset stays bit-identical to
     :func:`~rayfed_tpu.fl.fedavg.packed_quantized_sum` over that
     subset (integer adds are exact whatever the refold order).  The
-    broadcast carries the finalized f32 aggregate.  ``quant_scope``
-    keys the error-feedback residual as in ``streaming_aggregate``;
-    it commits only when this round's broadcast lands, so a failover
-    re-push re-quantizes the SAME update with the SAME residual.
+    broadcast re-quantizes the aggregate on a FRESH payload-carried
+    grid (:func:`~rayfed_tpu.fl.quantize.quantize_downlink`, shared
+    with ``streaming_aggregate`` — quantized-quorum and quantized-
+    streaming rounds are byte-identical by construction).
+    ``quant_scope`` keys the error-feedback residual as in
+    ``streaming_aggregate``; it commits only when this round's
+    broadcast lands, so a failover re-push re-quantizes the SAME
+    update with the SAME residual.
+
+    ``secagg``: the transport's key-agreement plane
+    (:class:`~rayfed_tpu.transport.secagg.KeyAgreement`) — the round
+    runs **masked** (:mod:`rayfed_tpu.fl.secagg`): contributions ship
+    as ``w·q + pairwise masks`` in i32, the coordinator folds at unit
+    weight (masks cancel bit-exactly; it learns only the sum), and the
+    deadline-gated cutoff triggers dropout mask recovery — the
+    coordinator's post-cutoff announcement (``<round>.sa.c``) names the
+    pinned members, each survivor replies with its pairwise seeds
+    toward the dropped parties (``<round>.sa.r.<party>``; scoped to
+    THIS round's seeds — the per-round HKDF keeps other rounds dark),
+    and the orphaned masks are subtracted before the finalize rescale.
+    A coordinator crash anywhere in that window reaches the driver's
+    failover arm like any other coordinator death: the successor
+    re-establishes the round under its own stream scope, which re-keys
+    every mask.  Requires ``quant``.
     """
     from rayfed_tpu.proxy import recv_on_runtime
 
@@ -191,10 +212,40 @@ def quorum_aggregate(
 
     # ONE shared sender-side codec discipline (fl.quantize.RoundCodec:
     # grid-fingerprint check + EF two-phase commit, identical across
-    # streaming/ring/quorum); no-op when quant is None.
+    # streaming/ring/quorum); no-op when quant is None.  With secagg,
+    # the masked codec rides the same discipline plus the fused
+    # weight-and-mask step (fl.secagg).
+    from rayfed_tpu.fl import quantize as qz
     from rayfed_tpu.fl.quantize import RoundCodec
 
-    codec = RoundCodec(quant, quant_ref, quant_scope)
+    masker = None
+    if secagg is not None:
+        if quant is None:
+            raise QuorumRoundError(
+                "secure aggregation requires the quantized domain "
+                "(quant=) — masks live on the shared integer grid"
+            )
+        from rayfed_tpu.fl import secagg as sa
+        from rayfed_tpu.fl.fedavg import quant_weights
+
+        iw, _ = quant_weights(
+            None if weights is None
+            else [float(weights[p]) for p in parties],
+            len(parties),
+        )
+        masker = sa.RoundMasker(
+            secagg, me, [p for p in parties if p != me],
+            session=session, stream=stream, round_index=round_index,
+            weight=iw[parties.index(me)],
+            # Double-masking: quorum rounds can EXCLUDE a live
+            # straggler, and recovering its pairwise masks would
+            # otherwise unmask its late-arriving payload — the private
+            # self-mask (revealed only by members) keeps it noise.
+            self_mask=True,
+        )
+        codec = sa.MaskedRoundCodec(quant, quant_ref, quant_scope, masker)
+    else:
+        codec = RoundCodec(quant, quant_ref, quant_scope)
     qref = codec.ref
     q_descriptor = codec.descriptor
     _to_wire = codec.to_wire
@@ -214,7 +265,12 @@ def quorum_aggregate(
             local_ref = local_ref.then(_to_wire)
         runtime.send_proxy.send(
             coordinator, local_ref, f"{down}.up.{me}",
-            down, stream=f"{stream}/up/{me}", round_tag=round_index,
+            down,
+            # Masked codes are fresh uniform noise every round — a
+            # delta stream would hash every chunk and pin a model-
+            # sized base for zero hits; plain sends skip all of that.
+            stream=None if masker is not None else f"{stream}/up/{me}",
+            round_tag=round_index,
             epoch_tag=epoch, quant_meta=q_descriptor,
         )
         # The push result is deliberately not awaited as a success
@@ -222,6 +278,42 @@ def quorum_aggregate(
         # advanced) — that is the protocol working, not a failure; the
         # local progress folds into the next round via dga_correct.
         try:
+            if masker is not None:
+                # Masked round: the coordinator's post-cutoff
+                # announcement arrives BEFORE the result broadcast,
+                # naming the pinned member set and any dropped parties.
+                # Survivors reply with their pairwise seeds toward the
+                # dropped so the coordinator can subtract the orphaned
+                # masks pre-finalize; excluded-but-alive stragglers
+                # (not in "m") just fall through to the broadcast.
+                # Inside this try on purpose: a coordinator crash in
+                # the recovery window must reach the driver's failover
+                # arm as a QuorumRoundError like any other
+                # coordinator death.
+                ctl = sa.check_recovery_message(
+                    recv_on_runtime(
+                        runtime, coordinator, f"{down}.sa.c", down
+                    ).resolve(timeout=backstop),
+                    "request",
+                )
+                dropped = list(ctl["dr"])
+                if me in ctl["m"]:
+                    # EVERY member replies: its self-mask seed (its
+                    # contribution is in the sum, so its PRG(b) must be
+                    # subtracted) plus, on a dropout, its pairwise
+                    # seeds toward the dropped.  An EXCLUDED party
+                    # falls through silently — its b stays private,
+                    # which is exactly what keeps its late payload
+                    # uniform noise despite the pairwise recovery.
+                    runtime.send_proxy.send(
+                        coordinator,
+                        sa.make_recovery_reply(
+                            me, masker.recovery_seeds(dropped),
+                            masker.self_seed_hex(),
+                        ),
+                        f"{down}.sa.r.{me}", down,
+                        round_tag=round_index, epoch_tag=epoch,
+                    )
             value = recv_on_runtime(
                 runtime, coordinator, f"{down}.down", down
             ).resolve(timeout=backstop)
@@ -232,10 +324,23 @@ def quorum_aggregate(
                 f"{coordinator!r} failed: {exc!r}"
             ) from exc
         _quant_commit()
+        result_val = value["d"]
+        if quant is not None and isinstance(
+            result_val, qz.QuantizedPackedTree
+        ):
+            # Quantized downlink: decode with the grid the payload
+            # itself carries — bit-identical to the coordinator's own
+            # return value (same codes, same rescale, same shared ref).
+            import numpy as _np
+
+            result_val = result_val.dequantize(
+                _np.float32,
+                ref=qref if result_val.gmeta.mode == "delta" else None,
+            )
         if timings is not None:
             timings["agg_s"] = time.perf_counter() - t0
         return QuorumRoundOutcome(
-            value["d"], list(value["m"]), value.get("a"), []
+            result_val, list(value["m"]), value.get("a"), []
         )
 
     # -- coordinator ---------------------------------------------------------
@@ -245,11 +350,77 @@ def quorum_aggregate(
     w_list = (
         None if weights is None else [float(weights[p]) for p in parties]
     )
+    others = [p for p in parties if p != me]
     agg_kwargs = {}
     if quant is not None:
         # The fold grid IS the quantization grid.
         agg_kwargs["chunk_elems"] = quant.chunk_elems
         agg_kwargs["quant_ref"] = qref
+    if masker is not None:
+        def _mask_recovery(member_labels):
+            # Runs on the aggregator worker between the cutoff (member
+            # set pinned) and the finalize rescale.  The chaos hook
+            # sits FIRST: a harness can kill the coordinator in the
+            # recovery window — survivors parked on the announcement
+            # can only be saved by the health monitor + failover.
+            chaos.fire(
+                "secagg_recovery", party=me, round=round_index,
+                epoch=epoch,
+            )
+            dropped = sorted(set(parties) - set(member_labels))
+            # Announce to EVERY active peer (excluded stragglers too —
+            # they are parked on this key and fall through to the
+            # broadcast); a dead party's send just fails best-effort.
+            runtime.send_proxy.send_many(
+                others,
+                sa.make_recovery_request(member_labels, dropped),
+                f"{down}.sa.c", down,
+                round_tag=round_index, epoch_tag=epoch,
+            )
+            from rayfed_tpu.fl.secagg import SECAGG_STATS
+
+            if dropped:
+                SECAGG_STATS["mask_recoveries"] += 1
+                logger.warning(
+                    "round %d: recovering masks of dropped parties %s "
+                    "from %d survivors", round_index, dropped,
+                    len(member_labels),
+                )
+            survivor_seeds = {}
+            self_seeds = {}
+            if me in member_labels:
+                survivor_seeds[me] = masker.recovery_seeds(dropped)
+                self_seeds[me] = masker.self_seed_hex()
+            # Park every member's reply recv FIRST, resolve after: the
+            # replies are independent, so the cutoff round trip costs
+            # one RTT, not len(members) sequential ones.
+            reply_refs = {
+                p: recv_on_runtime(runtime, p, f"{down}.sa.r.{p}", down)
+                for p in member_labels if p != me
+            }
+            for p, ref in reply_refs.items():
+                reply = sa.check_recovery_message(
+                    ref.resolve(timeout=backstop), "reply",
+                )
+                if str(reply["p"]) != p:
+                    # The reply's self-declared sender decides mask
+                    # SIGNS (sorted-name order) — a mis-stamped reply
+                    # would silently corrupt the correction.
+                    raise sa.SecAggError(
+                        f"recovery reply on {p!r}'s rendezvous claims "
+                        f"to be from {reply['p']!r} — refusing to "
+                        f"finalize the round"
+                    )
+                survivor_seeds[p] = dict(reply["sd"])
+                self_seeds[p] = str(reply["b"])
+            return sa.mask_correction(
+                survivor_seeds, dropped, quant.total_elems,
+                secagg.prg_scheme, members=member_labels,
+                self_seeds=self_seeds,
+            )
+
+        agg_kwargs["masked"] = True
+        agg_kwargs["mask_recovery"] = _mask_recovery
     agg = StreamingAggregator(
         len(parties),
         weights=w_list,
@@ -286,7 +457,6 @@ def quorum_aggregate(
             cancel_keys.append((p, f"{down}.up.{p}", down))
     if sink_entries:
         runtime.transport.recv_stream_many(sink_entries)
-    others = [p for p in parties if p != me]
     try:
         result = agg.result(timeout=backstop, deadline_s=deadline_s)
         members = [parties[i] for i in agg.quorum_members]
@@ -308,9 +478,21 @@ def quorum_aggregate(
             announce, welcomes = announce_fn(members)
     except BaseException as exc:
         _quant_rollback()
+        if isinstance(exc, chaos.ChaosPartyCrash):
+            # An injected crash must look like a REAL one: no poison,
+            # no graceful QuorumRoundError wrap — the survivors' health
+            # monitors + failover are what the harness is exercising.
+            # (The secagg_recovery hook fires on the aggregator worker,
+            # so the crash surfaces here rather than at a driver-level
+            # chaos.fire call.)
+            raise
         # Peers are parked on the broadcast — poison it so they learn
-        # the round died now, not at their backstop.
+        # the round died now, not at their backstop.  Masked peers may
+        # still be parked one step earlier, on the recovery
+        # announcement — poison that key too.
         _poison_round_key(runtime, others, f"{down}.down", down, exc)
+        if masker is not None:
+            _poison_round_key(runtime, others, f"{down}.sa.c", down, exc)
         for _p, up, dwn in cancel_keys:
             runtime.transport.cancel_stream(up, dwn)
         raise QuorumRoundError(
@@ -324,10 +506,21 @@ def quorum_aggregate(
     # Deliberately OUTSIDE the poison-protected block: an injected
     # crash must look like a real one, not a graceful goodbye.
     chaos.fire("announce", party=me, round=round_index, epoch=epoch)
-    payload = {"d": result, "m": members, "a": announce}
+    wire_result = result
+    down_descriptor = None
+    if quant is not None:
+        # Quantize the result broadcast too — the downlink is the
+        # other half of the round's bytes.  Shared producer with
+        # streaming_aggregate (qz.quantize_downlink), so quantized-
+        # quorum and quantized-streaming rounds stay byte-identical.
+        wire_result, result, down_descriptor = qz.quantize_downlink(
+            result, quant, qref, quant_scope
+        )
+    payload = {"d": wire_result, "m": members, "a": announce}
     refs = runtime.send_proxy.send_many(
         others, payload, f"{down}.down", down,
         stream=f"{stream}/down", round_tag=round_index, epoch_tag=epoch,
+        quant_meta=down_descriptor,
     )
     delivered = 0
     for p, ref in refs.items():
@@ -439,6 +632,8 @@ def run_quorum_rounds(
     round_log: Optional[list] = None,
     checkpointer: Any = None,
     checkpoint_every: int = 0,
+    wire_quant: Optional[str] = None,
+    secure_agg: bool = False,
 ) -> Any:
     """The quorum-mode round loop behind ``run_fedavg_rounds(quorum=k)``.
 
@@ -471,6 +666,27 @@ def run_quorum_rounds(
       trail of who was on the roster, who made each round's quorum, and
       who coordinated it (tests and the chaos bench replay the exact
       FedAvg recurrence from it).
+    - ``wire_quant`` (``"uint8"``/``"int8"``): quorum rounds run **in
+      the compressed domain** — every controller derives the identical
+      shared grid from the previous round's observed aggregate delta
+      (the first round bootstraps unquantized, exactly like the classic
+      loop), contributions quantize onto it, the coordinator folds
+      integer codes with the deadline-gated cutoff, and BOTH directions
+      ride 8-bit codes (the downlink re-quantizes on a fresh payload-
+      carried grid shared with ``streaming_aggregate`` — quantized-
+      quorum and quantized-streaming rounds are byte-identical).  A
+      joiner's welcome carries the current grid reference delta, so
+      elastic membership composes.  Coordinator topology only
+      (``mode="ring"`` + ``wire_quant`` is a loud exclusion).
+    - ``secure_agg``: mask the quantized contributions with pairwise
+      masks derived from the transport's HELLO key agreement
+      (:mod:`rayfed_tpu.fl.secagg`) — the coordinator learns only the
+      sum; a quorum dropout triggers mask recovery before finalize, and
+      a coordinator crash in the recovery window reaches the failover
+      arm like any other coordinator death (the successor re-runs
+      recovery on its failover stream).  Requires ``wire_quant``; the
+      bootstrap round (no grid yet) runs unquantized AND unmasked —
+      see ``docs/source/secure_aggregation.rst``.
     - ``checkpointer`` (+ ``checkpoint_every``): snapshot ``(round,
       roster epoch, member log, session, params)`` at round boundaries;
       the next call restores the latest snapshot — round index, roster
@@ -482,7 +698,10 @@ def run_quorum_rounds(
       restored global model — at most one round of its local work is
       lost, the same bound a crash already implies.
     """
+    import numpy as np
+
     import rayfed_tpu as fed
+    from rayfed_tpu.fl import quantize as qz
     from rayfed_tpu.fl.compression import PackedTree, compress, decompress
     from rayfed_tpu.fl.overlap import dga_correct
     from rayfed_tpu.runtime import get_runtime
@@ -495,6 +714,29 @@ def run_quorum_rounds(
             "this transport has no roster (quorum rounds need the "
             "single-process TransportManager or a multi-host leader)"
         )
+    if wire_quant is not None and mode == "ring":
+        raise QuorumRoundError(
+            "quantized quorum rounds run the coordinator topology — "
+            "mode='ring' with wire_quant is a loud exclusion (the "
+            "quorum ring has not been taught the quantized stripe "
+            "shape), never a silent fallback"
+        )
+    secagg_keys = None
+    if secure_agg:
+        if wire_quant is None:
+            raise QuorumRoundError(
+                "secure_agg requires wire_quant — masks live in the "
+                "shared-grid integer domain (fl.secagg)"
+            )
+        secagg_keys = getattr(transport, "secagg_keys", None)
+        if secagg_keys is None or not hasattr(
+            transport, "ensure_secagg_peer_keys"
+        ):
+            raise QuorumRoundError(
+                "secure_agg needs the transport key-agreement plane "
+                "(TransportManager.secagg_keys) — this transport has "
+                "none"
+            )
     me = runtime.party
     all_parties = sorted(trainers)
     cluster_parties = sorted(runtime.cluster_config.parties)
@@ -536,6 +778,13 @@ def run_quorum_rounds(
     if checkpointer is not None and join_ticket is None:
         restored = _restore_quorum_snapshot(checkpointer, params, roster, log)
 
+    # Compressed-domain state: the previous round's observed aggregate
+    # delta (derived from broadcast values only — bit-identical on every
+    # controller), the range reference for the next round's grid.  None
+    # until one round has been observed: the first round bootstraps
+    # unquantized (and, under secure_agg, unmasked).
+    quant_prev_delta = None
+
     if join_ticket is not None:
         start_round = int(join_ticket["round"])
         session = str(join_ticket["session"])
@@ -544,6 +793,11 @@ def run_quorum_rounds(
         # entering after a failover or handover must not anchor at the
         # departed party.
         coord = str(join_ticket.get("coordinator", coord))
+        # Quantized runs: the welcome carries the grid reference delta,
+        # so the joiner derives the SAME round grid as everyone else
+        # instead of desyncing into an unquantized bootstrap.
+        if wire_quant is not None:
+            quant_prev_delta = join_ticket.get("qd")
     elif restored is not None:
         start_round, session, params = restored
         if start_round >= rounds:
@@ -619,6 +873,29 @@ def run_quorum_rounds(
                 f"round {r}: live roster {active} is smaller than the "
                 f"quorum ({quorum}) — the run cannot make progress"
             )
+        if secure_agg:
+            # Pairwise key agreement rides the HELLO handshake; one
+            # ping per missing pair establishes it, and a no-op when
+            # every active peer's key is already recorded (so elastic
+            # joins compose: the round after a joiner's epoch advance
+            # pings it once).
+            transport.ensure_secagg_peer_keys(active)
+        # Compressed-domain round: the shared grid derives from the
+        # previous round's observed aggregate delta, the reference is
+        # the round's shared starting model — both bit-identical on
+        # every controller (that IS the negotiation; the fingerprint
+        # rides every quantized frame).
+        round_grid = None
+        round_ref = None
+        if wire_quant is not None:
+            round_ref = np.asarray(current.buf).astype(
+                np.float32
+            ).reshape(-1)
+            if quant_prev_delta is not None:
+                round_grid = qz.make_round_grid(
+                    quant_prev_delta, wire_dtype=wire_quant,
+                    mode="delta", expand=qz.QUANT_DELTA_EXPAND,
+                )
         rec = None
         if timings is not None:
             rec = {"local_s": 0.0, "push_s": 0.0, "agg_s": 0.0,
@@ -663,6 +940,14 @@ def run_quorum_rounds(
                     ring_chunk_elems=ring_chunk_elems,
                     announce_fn=announce_fn, backstop=backstop,
                     active=active, timings=rec,
+                    quant=round_grid, quant_ref=round_ref,
+                    # EF residual keyed by the CALLER's stream name, not
+                    # the failover-scoped one: the residual must carry
+                    # across attempts and coordinators.
+                    quant_scope=stream if round_grid is not None else None,
+                    secagg=(
+                        secagg_keys if round_grid is not None else None
+                    ),
                 )
                 break
             except QuorumRoundError as exc:
@@ -722,6 +1007,14 @@ def run_quorum_rounds(
             "members": list(members), "coordinator": coord,
         })
         current = avg
+        if wire_quant is not None:
+            # Next round's grid range: how far the global model just
+            # moved, per block — derived from broadcast values only,
+            # so bit-identical on every controller.
+            quant_prev_delta = (
+                np.asarray(avg.buf).astype(np.float32).reshape(-1)
+                - round_ref
+            )
         if rec is not None:
             rec["agg_s"] = max(
                 0.0, rec.get("agg_s", 0.0) - rec["local_s"]
@@ -733,6 +1026,7 @@ def run_quorum_rounds(
             _send_welcomes(
                 runtime, outcome.welcomes, roster, current, r + 1,
                 session, backstop, coordinator=next_coord,
+                quant_delta=quant_prev_delta,
             )
         coord = next_coord
         if checkpointer is not None and checkpoint_every and (
@@ -768,7 +1062,8 @@ def _effective_stream(stream: str, coord: str, coord0: str) -> str:
 def _aggregate_with_mode(
     runtime, updates, w_map, *, session, round_index, quorum, deadline_s,
     coordinator, stream, epoch, mode, ring_chunk_elems, announce_fn,
-    backstop, active, timings,
+    backstop, active, timings, quant=None, quant_ref=None,
+    quant_scope=None, secagg=None,
 ) -> QuorumRoundOutcome:
     """Ring-first aggregation when ``mode="ring"``: a straggler or dead
     party aborts the ring on every controller (poison cascade + commit
@@ -779,6 +1074,15 @@ def _aggregate_with_mode(
 
     me = runtime.party
     down = _round_key(session, stream, round_index)
+    if quant is not None and mode == "ring":
+        # Loud exclusion, never a silent fallback: the quorum ring has
+        # not been taught the quantized round shape (the grid chunking
+        # vs ring stripe grid interaction) — the driver validates this
+        # up front, so reaching here is a programming error.
+        raise QuorumRoundError(
+            "quantized quorum rounds run the coordinator topology — "
+            "mode='ring' with quant= is not supported"
+        )
     if mode == "ring" and len(active) > 1:
         from rayfed_tpu.fl.ring import RING_STATS, RingRoundError, ring_aggregate
 
@@ -859,7 +1163,8 @@ def _aggregate_with_mode(
         runtime, updates, w_map, session=session, round_index=round_index,
         quorum=quorum, deadline_s=deadline_s, coordinator=coordinator,
         stream=stream, epoch=epoch, announce_fn=announce_fn,
-        backstop=backstop, timings=timings,
+        backstop=backstop, timings=timings, quant=quant,
+        quant_ref=quant_ref, quant_scope=quant_scope, secagg=secagg,
     )
 
 
@@ -898,14 +1203,17 @@ def _restore_quorum_snapshot(checkpointer, params, roster, log):
 
 
 def _send_welcomes(runtime, welcomes, roster, current, next_round,
-                   session, backstop, coordinator: str) -> None:
+                   session, backstop, coordinator: str,
+                   quant_delta=None) -> None:
     """Coordinator: hand each joiner everything it needs to enter the
     loop at the next round — round index, session, the current roster
     epoch, the CURRENT coordinator (post-handover, so a rejoiner never
-    anchors at a departed party), and the current global model.
-    Best-effort: a joiner that died again simply re-requests later.
-    Direct transport send — see quorum_aggregate on why membership
-    control traffic skips the cleanup send-watchdog."""
+    anchors at a departed party), the current global model, and (for
+    compressed-domain runs) the grid reference delta the next round's
+    shared grid derives from.  Best-effort: a joiner that died again
+    simply re-requests later.  Direct transport send — see
+    quorum_aggregate on why membership control traffic skips the
+    cleanup send-watchdog."""
     epoch, members = roster.snapshot()
     for party, nonce in welcomes:
         payload = {
@@ -916,6 +1224,8 @@ def _send_welcomes(runtime, welcomes, roster, current, next_round,
             "coordinator": coordinator,
             "params": current,
         }
+        if quant_delta is not None:
+            payload["qd"] = quant_delta
         ref = runtime.send_proxy.send(
             party, payload, f"roster.welcome.{party}.{nonce}", "roster",
         )

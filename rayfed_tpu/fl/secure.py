@@ -1,171 +1,36 @@
-"""Secure aggregation: pairwise-masked sums that reveal only the total.
+"""DEPRECATED shim — the secure-aggregation subsystem moved to
+:mod:`rayfed_tpu.fl.secagg`.
 
-Cross-silo FL's canonical privacy primitive (Bonawitz et al., "Practical
-Secure Aggregation", 2017): each party adds a random mask per peer —
-``+mask(i,j)`` when ``i < j`` and ``−mask(i,j)`` when ``i > j`` — so
-every mask appears exactly once positive and once negative across the
-parties, and the *sum* of the masked updates equals the sum of the raw
-updates while any single masked update is indistinguishable from noise.
+This module was the seed-era demo: whole-tree fixed-point masking with
+an operator-provisioned group key, applied around ``fed.get``.  The
+real subsystem now lives in :mod:`rayfed_tpu.fl.secagg` (masking in the
+shared-grid integer domain, pairwise key agreement riding the transport
+HELLO handshake, quorum-dropout mask recovery — wired through
+``run_fedavg_rounds(secure_agg=True)``), and the in-process primitives
+this module exported live there too:
 
-Exactness: floating-point masking would leak through rounding (the
-masks only cancel approximately), so updates are carried in **uint32
-fixed-point with wraparound** — addition mod 2³² is associative, masks
-cancel bit-exactly, and the only loss is the fixed-point quantization
-chosen by ``frac_bits``.
+- :func:`~rayfed_tpu.fl.secagg.pairwise_key`
+- :func:`~rayfed_tpu.fl.secagg.mask_update`
+- :func:`~rayfed_tpu.fl.secagg.unmask_sum`
 
-Key material: ``pairwise_key`` derives the (i, j) seed from a shared
-``group_key`` + the party-name pair + the round number.  How the group
-key is provisioned is deployment policy (the reference leaves TLS certs
-to the operator the same way, ``tool/generate_tls_certs.py``); in
-production each pair would run a key exchange over the authenticated
-mTLS channel and feed the result in here.
-
-Usage (each party, same code — multi-controller):
-
-    masked = mask_update(update, party="alice", parties=parties,
-                         round_num=r, group_key=key, clip=8.0)
-    # push `masked` like any update; then on the aggregate:
-    total = unmask_sum(fed.get(masked_objs), clip=8.0)
-    avg = jax.tree_util.tree_map(lambda t: t / len(parties), total)
+Import them from ``rayfed_tpu.fl.secagg`` (or ``rayfed_tpu.fl``); this
+shim re-exports them unchanged and will be removed.
 """
 
 from __future__ import annotations
 
-import hashlib
-from typing import Any, Sequence
+import warnings
 
-import jax
-import jax.numpy as jnp
+from rayfed_tpu.fl.secagg import (  # noqa: F401
+    mask_update,
+    pairwise_key,
+    unmask_sum,
+)
 
-_MOD = 2**32
-
-
-def pairwise_key(group_key: bytes, a: str, b: str, round_num: int) -> bytes:
-    """256-bit seed for the (a, b) pair at one round — order-independent.
-
-    The full digest feeds the mask XOF: truncating to a JAX PRNGKey
-    would cap the keyspace at threefry's 64 bits, which an
-    honest-but-curious aggregator could brute-force offline against a
-    single masked update.
-    """
-    lo, hi = sorted((a, b))
-    lo_b, hi_b = lo.encode(), hi.encode()
-    # Length-prefixed components: a '|'-delimited preimage would let
-    # names containing '|' collide across pairs (('a','b|c') vs
-    # ('a|b','c')), handing one pair another pair's mask seed.
-    return hashlib.sha256(
-        b"rayfed-secagg|%d:%s|%d:%s|%d|"
-        % (len(lo_b), lo_b, len(hi_b), hi_b, round_num)
-        + group_key
-    ).digest()
-
-
-def _encode(tree: Any, clip: float, frac_bits: int) -> Any:
-    """Float pytree → uint32 fixed-point (two's-complement wrap).
-
-    Values are clipped to ±``clip`` first: fixed-point needs a known
-    range, and secure aggregation deployments clip updates anyway (the
-    mask hides magnitudes only within the ring).
-    """
-    scale = float(2**frac_bits)
-
-    def enc(x):
-        x = jnp.clip(x.astype(jnp.float32), -clip, clip)
-        # int32 → uint32 astype is the two's-complement embedding into
-        # the ring (wraps mod 2³²); clip·2^frac_bits < 2³¹ keeps the
-        # int32 exact.  No int64 needed (x64 mode stays off).
-        return jnp.round(x * scale).astype(jnp.int32).astype(jnp.uint32)
-
-    return jax.tree_util.tree_map(enc, tree)
-
-
-def _decode(tree: Any, frac_bits: int) -> Any:
-    """uint32 fixed-point sum → float pytree.
-
-    uint32 → int32 astype is the two's-complement read (values ≥ 2³¹
-    become negative) — exact while |true sum| < 2³¹, which
-    :func:`unmask_sum` guards.
-    """
-    scale = float(2**frac_bits)
-    return jax.tree_util.tree_map(
-        lambda x: x.astype(jnp.int32).astype(jnp.float32) / scale, tree
-    )
-
-
-def _mask_for(seed: bytes, tree: Any) -> Any:
-    """One uint32 mask per element, expanded from the 256-bit pair seed.
-
-    SHAKE-256 as the XOF (domain-separated per leaf index) keeps the
-    full seed entropy — unlike JAX's threefry PRNG, whose 64-bit key
-    would be the scheme's effective security level.
-    """
-    import numpy as np
-
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    masks = []
-    for i, leaf in enumerate(leaves):
-        stream = hashlib.shake_256(
-            seed + b"|leaf|%d" % i
-        ).digest(4 * leaf.size)
-        masks.append(
-            jnp.asarray(
-                np.frombuffer(stream, dtype=np.uint32).reshape(leaf.shape)
-            )
-        )
-    return jax.tree_util.tree_unflatten(treedef, masks)
-
-
-def mask_update(
-    tree: Any,
-    *,
-    party: str,
-    parties: Sequence[str],
-    round_num: int,
-    group_key: bytes,
-    clip: float = 8.0,
-    frac_bits: int = 16,
-) -> Any:
-    """Fixed-point-encode ``tree`` and add this party's pairwise masks.
-
-    Returns a uint32 pytree safe to push: without the peers' masked
-    updates it is uniformly random in the ring.  ``clip``/``frac_bits``
-    must match across parties and in :func:`unmask_sum`.
-    """
-    if party not in parties:
-        raise ValueError(f"party {party!r} not in {list(parties)!r}")
-    out = _encode(tree, clip, frac_bits)
-    for peer in parties:
-        if peer == party:
-            continue
-        mask = _mask_for(pairwise_key(group_key, party, peer, round_num), out)
-        sign = 1 if party < peer else -1
-        out = jax.tree_util.tree_map(
-            # uint32 arithmetic wraps mod 2^32 — exactly the ring we want.
-            (lambda o, m: o + m) if sign > 0 else (lambda o, m: o - m),
-            out,
-            mask,
-        )
-    return out
-
-
-def unmask_sum(
-    masked_trees: Sequence[Any], *, frac_bits: int = 16, clip: float = 8.0
-) -> Any:
-    """Sum all parties' masked updates; masks cancel bit-exactly.
-
-    Returns the float **sum** of the clipped updates (divide by the
-    party count for the average).  ``clip`` bounds the representable
-    sum: n·clip must stay below 2^(31−frac_bits) or the ring wraps.
-    """
-    n = len(masked_trees)
-    if n == 0:
-        raise ValueError("unmask_sum needs at least one masked update")
-    if n * clip >= float(2 ** (31 - frac_bits)):
-        raise ValueError(
-            f"{n} parties at clip={clip} overflow the ring at "
-            f"frac_bits={frac_bits}; lower frac_bits or clip"
-        )
-    total = masked_trees[0]
-    for t in masked_trees[1:]:
-        total = jax.tree_util.tree_map(lambda a, b: a + b, total, t)
-    return _decode(total, frac_bits)
+warnings.warn(
+    "rayfed_tpu.fl.secure is deprecated: the secure-aggregation "
+    "subsystem lives in rayfed_tpu.fl.secagg (transport rounds: "
+    "run_fedavg_rounds(secure_agg=True))",
+    DeprecationWarning,
+    stacklevel=2,
+)

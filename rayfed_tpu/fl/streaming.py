@@ -170,6 +170,8 @@ class StreamingAggregator:
         labels: Optional[Sequence[str]] = None,
         quant: Optional[Any] = None,
         quant_ref: Optional[Any] = None,
+        masked: bool = False,
+        mask_recovery: Optional[Any] = None,
     ) -> None:
         if n_sources < 1:
             raise ValueError("streaming aggregation needs >= 1 source")
@@ -251,6 +253,27 @@ class StreamingAggregator:
             # headroom bound, so the float bookkeeping stays exact.
             self._weights = [float(w) for w in iw]
             self._total_w = float(itotal)
+        # Secure aggregation (fl.secagg): contributions arrive as
+        # MASKED i32 codes — ``w_i·q_i + net pairwise mask`` — and fold
+        # at UNIT weight through the unchanged integer kernel (the
+        # party already folded its own weight in; weighted pairwise
+        # masks could not cancel).  The float weight bookkeeping above
+        # stays the TRUE example counts: the quorum cutoff's Σw reweight
+        # and the finalize's zero-point term need them, and both see
+        # exactly the unmasked round's numbers — which is what keeps
+        # masked and unmasked rounds byte-identical.  ``mask_recovery``
+        # (quorum rounds): called on the worker with the member labels
+        # BEFORE finalize; returns the dropout rounds' orphaned-mask
+        # correction (uint32, fl.secagg.mask_correction) or None.
+        self._masked = bool(masked)
+        self._mask_recovery = mask_recovery
+        if self._masked and quant is None:
+            raise ValueError(
+                "masked aggregation requires quant= (the round's shared "
+                "grid) — masks live in the integer domain"
+            )
+        if mask_recovery is not None and not self._masked:
+            raise ValueError("mask_recovery only applies with masked=True")
         self._n = n_sources
         self._streams = [_Stream() for _ in range(n_sources)]
         # Quorum (k-of-n) mode: the first k completed contributions may
@@ -315,6 +338,19 @@ class StreamingAggregator:
                         "compressed-domain aggregation consumes "
                         "QuantizedPackedTree contributions — quantize "
                         "onto the round grid first (fl.quantize)"
+                    )
+                )
+                return
+            from rayfed_tpu.fl.secagg import MaskedCodeTree
+
+            if self._masked != isinstance(packed_tree, MaskedCodeTree):
+                self.fail(
+                    TypeError(
+                        "masked fold got an unmasked contribution"
+                        if self._masked else
+                        "got a MaskedCodeTree but this aggregator is "
+                        "not masked — construct it with masked=True "
+                        "(fl.secagg) or send plain quantized codes"
                     )
                 )
                 return
@@ -727,12 +763,21 @@ class StreamingAggregator:
             )
         self._wire_dtype = s.dtype
         if self._quant is not None:
-            if s.dtype != np.dtype(self._quant.wire_dtype):
+            # Masked rounds widen the grid codes to i32 (the mod-2³²
+            # ring the pairwise masks live in — fl.secagg); plain
+            # quantized rounds carry the grid's own integer width.
+            from rayfed_tpu.fl.secagg import MASKED_WIRE_DTYPE
+
+            want_dt = (
+                MASKED_WIRE_DTYPE if self._masked
+                else self._quant.wire_dtype
+            )
+            if s.dtype != np.dtype(want_dt):
                 raise ValueError(
                     f"compressed-domain contribution carries "
-                    f"{s.dtype} codes, the round grid is "
-                    f"{self._quant.wire_dtype} — sender and receiver "
-                    f"disagree on the grid"
+                    f"{s.dtype} codes, this round folds {want_dt} "
+                    f"({'masked' if self._masked else 'plain'} mode) — "
+                    f"sender and receiver disagree on the round shape"
                 )
             if (
                 self._quant_full
@@ -923,11 +968,15 @@ class StreamingAggregator:
                     )
             for i, lo, hi, src in work:
                 s = self._streams[i]
-                w = (
-                    np.int32(self._int_weights[i])
-                    if self._int_weights is not None
-                    else np.float32(self._weights[i])
-                )
+                if self._masked:
+                    # The party folded its own weight into the masked
+                    # codes; pairwise masks only cancel at unit fold
+                    # weight (fl.secagg).
+                    w = np.int32(1)
+                elif self._int_weights is not None:
+                    w = np.int32(self._int_weights[i])
+                else:
+                    w = np.float32(self._weights[i])
                 t0 = time.perf_counter()
                 for b in range(lo, hi):
                     self._acc = kernel(
@@ -995,6 +1044,37 @@ class StreamingAggregator:
             from rayfed_tpu.fl.fedavg import finalize_packed_quantized
 
             self._verify_quant_members(members)
+            if self._masked and self._mask_recovery is not None:
+                # Dropout mask recovery (quorum rounds): the hook runs
+                # the announce/reply round trip with the survivors and
+                # returns the orphaned-mask correction — which must be
+                # subtracted BEFORE the rescale (this worker is the
+                # only accumulator mutator, so mid-round recovery can
+                # only live here).  With no dropouts it still announces
+                # the pinned member set (the survivors' receive
+                # protocol is deterministic) and returns None.
+                corr = self._mask_recovery(
+                    [self._labels[i] for i in members]
+                )
+                if corr is not None:
+                    from rayfed_tpu.fl.fedavg import (
+                        masked_correction_kernel,
+                    )
+
+                    corr = np.asarray(corr, np.uint32).reshape(-1)
+                    if corr.size != self._total_elems:
+                        raise ValueError(
+                            f"mask correction covers {corr.size} "
+                            f"elements, round folds {self._total_elems}"
+                        )
+                    pad = self._nblocks * self._chunk_elems - corr.size
+                    if pad:
+                        corr = np.concatenate(
+                            [corr, np.zeros(pad, np.uint32)]
+                        )
+                    self._acc = masked_correction_kernel()(
+                        self._acc, corr
+                    )
             out_dt = self._out_dtype or np.dtype(np.float32)
             out_buf = finalize_packed_quantized(
                 self._acc, self._quant.scales, self._quant.zps,
@@ -1037,6 +1117,7 @@ class StreamingAggregator:
         be a QuantizedPackedTree coded on exactly the round grid.
         Local contributions were checked at ``add_local``."""
         from rayfed_tpu.fl.quantize import QuantizedPackedTree
+        from rayfed_tpu.fl.secagg import MaskedCodeTree
 
         want = self._quant.meta()
         for i in members:
@@ -1049,6 +1130,14 @@ class StreamingAggregator:
                     f"contribution from {self._labels[i]} is not a "
                     f"QuantizedPackedTree — all parties must quantize "
                     f"onto the round's shared grid"
+                )
+            if self._masked != isinstance(tree, MaskedCodeTree):
+                raise TypeError(
+                    f"contribution from {self._labels[i]} is "
+                    f"{'unmasked' if self._masked else 'masked'} but "
+                    f"this round folds "
+                    f"{'masked' if self._masked else 'plain'} codes — "
+                    f"all parties must agree on secure_agg for the round"
                 )
             if tree.gmeta != want:
                 raise ValueError(
@@ -1284,6 +1373,7 @@ def streaming_aggregate(
     quant_ref: Optional[Any] = None,
     quant_scope: Optional[str] = None,
     quant_downlink: bool = False,
+    secagg: Optional[Any] = None,
 ) -> Any:
     """FedAvg round over the streaming + delta-cache pipeline.
 
@@ -1360,16 +1450,30 @@ def streaming_aggregate(
             )
     if quant_downlink and quant is None:
         raise ValueError("quant_downlink requires quant= (the grid)")
+    if secagg is not None and quant is None:
+        raise ValueError(
+            "secagg= requires quant= — masks live in the shared-grid "
+            "integer domain (fl.secagg)"
+        )
     # The sender-side codec discipline (grid check + EF two-phase
     # commit), shared verbatim with ring/quorum; a no-op when quant is
-    # None.
+    # None.  ``secagg`` (a fl.secagg.RoundMasker) swaps in the masked
+    # codec: same discipline, plus the fused weight-and-mask step — the
+    # coordinator then folds at unit weight and the masks cancel
+    # bit-exactly (no dropout recovery here: the all-of-n path fails
+    # the round on any loss, so no masks can orphan).
     from rayfed_tpu.fl import quantize as qz
 
     if quant is not None and out_dtype is None:
         # Integer codes make no sense as an output dtype — the
         # compressed-domain aggregate materializes in f32.
         out_dtype = np.float32
-    codec = qz.RoundCodec(quant, quant_ref, quant_scope)
+    if secagg is not None:
+        from rayfed_tpu.fl.secagg import MaskedRoundCodec
+
+        codec = MaskedRoundCodec(quant, quant_ref, quant_scope, secagg)
+    else:
+        codec = qz.RoundCodec(quant, quant_ref, quant_scope)
     qref = codec.ref
     q_descriptor = codec.descriptor
     _to_wire = codec.to_wire
@@ -1406,7 +1510,13 @@ def streaming_aggregate(
                 push_ref = send_on_runtime(
                     runtime, coord, local_ref,
                     obj.get_fed_task_id(), contrib_id,
-                    stream=f"{stream}/up/{me}/{own_seq}",
+                    # Masked codes are fresh uniform noise every round:
+                    # a delta stream would hash every chunk and pin a
+                    # model-sized base for zero hits — send plain.
+                    stream=(
+                        None if secagg is not None
+                        else f"{stream}/up/{me}/{own_seq}"
+                    ),
                     round_tag=round_tag,
                     quant_meta=q_descriptor,
                 )
@@ -1449,6 +1559,7 @@ def streaming_aggregate(
         out_dtype=out_dtype,
         quant=quant,
         quant_ref=qref,
+        masked=secagg is not None,
         # The fold grid IS the quantization grid (both are the
         # canonical packed_block_grid chunking).
         chunk_elems=(
@@ -1510,39 +1621,13 @@ def streaming_aggregate(
     down_descriptor = None
     if quant_downlink:
         # Re-quantize the aggregate for the broadcast on a FRESH grid
-        # derived from the aggregate itself — the coordinator is the
-        # only sender, so the grid can follow the exact data (tiny
-        # error) and it rides the payload: receivers (and rejoiners)
-        # need no negotiation.  The coordinator returns the
-        # DEQUANTIZED codes, so every controller holds the identical
-        # bytes.  Delta rounds code (aggregate − shared ref), the form
-        # whose range the 8-bit step actually resolves.
-        if qref is not None:
-            down_src = (
-                np.asarray(result.buf).astype(np.float32) - qref
-            )
-            down_grid = qz.make_round_grid(
-                down_src, chunk_elems=quant.chunk_elems,
-                wire_dtype=quant.wire_dtype, mode="delta",
-            )
-        else:
-            down_grid = qz.make_round_grid(
-                result.buf, chunk_elems=quant.chunk_elems,
-                wire_dtype=quant.wire_dtype, mode="abs",
-            )
-        dcomp = (
-            qz.compressor(f"{quant_scope}/down")
-            if quant_scope is not None else None
+        # derived from the aggregate itself (qz.quantize_downlink —
+        # shared with quorum_aggregate so the two downlinks stay
+        # byte-identical); the coordinator returns the DEQUANTIZED
+        # codes, so every controller holds the identical bytes.
+        wire_result, result, down_descriptor = qz.quantize_downlink(
+            result, quant, qref, quant_scope, out_dtype=out_dtype
         )
-        wire_result = (
-            dcomp.quantize(result, down_grid, ref=qref)
-            if dcomp is not None
-            else qz.quantize_packed(result, down_grid, ref=qref)
-        )
-        down_descriptor = qz.grid_descriptor(down_grid)
-        result = wire_result.dequantize(np.dtype(out_dtype), ref=qref)
-        if dcomp is not None:
-            dcomp.commit()
     if others:
         send_many_on_runtime(
             runtime, others, wire_result, result_id, result_id,

@@ -27,11 +27,10 @@ from rayfed_tpu.fl.fedopt import ServerOptimizer
 
 logger = logging.getLogger(__name__)
 
-# Headroom factor for compressed-domain uplink grids (wire_quant): the
-# grid range is the previous round's aggregate delta expanded by this —
-# per-party deltas overshoot their mean, and what still clips rides the
-# error-feedback residual into the next round.
-_QUANT_DELTA_EXPAND = 4.0
+# Headroom factor for compressed-domain uplink grids (wire_quant) —
+# shared with the quorum driver loop so both derive bit-identical grids
+# (see fl.quantize.QUANT_DELTA_EXPAND for the rationale).
+from rayfed_tpu.fl.quantize import QUANT_DELTA_EXPAND as _QUANT_DELTA_EXPAND
 
 
 def sample_parties(
@@ -80,6 +79,7 @@ def run_fedavg_rounds(
     round_deadline_s: Optional[float] = None,
     join_ticket: Optional[dict] = None,
     round_log: Optional[list] = None,
+    secure_agg: bool = False,
 ) -> Any:
     """Run ``rounds`` FedAvg rounds over party-pinned trainer actors.
 
@@ -149,10 +149,30 @@ def run_fedavg_rounds(
       reference add) at finalize — roughly half the bf16 wire bytes
       AND half the fold's HBM traffic.  The first round has no
       observed delta and runs unquantized (bootstrap).  Requires
-      ``compress_wire`` + ``packed_wire`` and ``streaming_agg=True``
-      or ``mode="ring"``; with streaming the result broadcast is
-      re-quantized too (fresh grid, carried in the payload).  Integral
+      ``compress_wire`` + ``packed_wire`` and ``streaming_agg=True``,
+      ``mode="ring"`` or ``quorum=`` (quantized quorum rounds run the
+      coordinator topology; ``quorum`` + ``mode="ring"`` +
+      ``wire_quant`` is a loud exclusion); on the streaming and quorum
+      paths the result broadcast is re-quantized too (fresh grid,
+      carried in the payload), and quantized-quorum rounds are
+      byte-identical to quantized-streaming rounds.  Integral
       non-negative ``weights`` only (example counts).
+    - ``secure_agg``: **secure aggregation**
+      (:mod:`rayfed_tpu.fl.secagg`; ``docs/source/
+      secure_aggregation.rst``) — each party's quantized contribution
+      is masked with pairwise masks derived from the transport's HELLO
+      key agreement, so the coordinator (and any single eavesdropped
+      payload) learns only the SUM of the round's updates, at zero
+      extra wire bytes for the masks themselves (they are generated
+      from agreed seeds, never transmitted; the masked codes widen to
+      i32 on the wire).  The masked round's aggregate is BYTE-identical
+      to the unmasked round's.  Requires ``wire_quant`` (masks live on
+      the shared integer grid) with the streaming or quorum paths
+      (``mode="ring"`` and ``sample`` are loud exclusions); composes
+      with ``quorum`` — a mid-round dropout triggers pairwise mask
+      recovery over the survivors, and coordinator failover re-runs
+      recovery on the successor's stream.  The bootstrap round (no
+      grid yet) runs unquantized AND unmasked.
     - ``mode``: the aggregation wire topology.  ``"coordinator"`` (the
       default) funnels contributions through one party (hub-and-spoke;
       with ``streaming_agg`` they fold as they arrive).  ``"ring"``
@@ -285,18 +305,23 @@ def run_fedavg_rounds(
                 "packed_wire=True (the quantized unit is the packed "
                 "wire buffer)"
             )
-        if not streaming_agg and mode != "ring":
+        if not streaming_agg and mode != "ring" and quorum is None:
             raise ValueError(
-                "wire_quant requires streaming_agg=True or mode='ring' "
-                "— the compressed-domain fold lives in the streaming/"
-                "striped aggregators (fl.quantize)"
+                "wire_quant requires streaming_agg=True, mode='ring' "
+                "or quorum= — the compressed-domain fold lives in the "
+                "streaming/striped aggregators (fl.quantize)"
+            )
+        if quorum is not None and mode == "ring":
+            raise ValueError(
+                "wire_quant + quorum runs the coordinator topology — "
+                "mode='ring' is a loud exclusion there (the quorum "
+                "ring has not been taught the quantized stripe shape)"
             )
         incompat_q = {
             "error_feedback": error_feedback,  # quant carries its OWN
             "aggregator": aggregator is not None,
             "server_opt": server_opt is not None,
             "overlap": overlap,
-            "quorum": quorum is not None,
         }
         bad_q = [k for k, v in incompat_q.items() if v]
         if bad_q:
@@ -304,7 +329,25 @@ def run_fedavg_rounds(
                 f"wire_quant is incompatible with {bad_q}: the "
                 f"grid codec carries its own error feedback, and the "
                 f"other paths have not been taught the quantized round "
-                f"shape (quorum_aggregate accepts quant= directly)"
+                f"shape"
+            )
+    if secure_agg:
+        if wire_quant is None:
+            raise ValueError(
+                "secure_agg requires wire_quant — pairwise masks live "
+                "in the shared-grid integer domain (fl.secagg); pass "
+                "e.g. wire_quant='uint8'"
+            )
+        if mode == "ring":
+            raise ValueError(
+                "secure_agg runs the streaming/quorum coordinator "
+                "topology — mode='ring' is a loud exclusion (stripe "
+                "owners would each see a maskable subset)"
+            )
+        if sample is not None and sample != len(trainers):
+            raise ValueError(
+                "secure_agg and sample are mutually exclusive: the "
+                "mask peer set is the round's full active roster"
             )
     if streaming_agg and not (compress_wire and packed_wire):
         raise ValueError(
@@ -508,6 +551,8 @@ def run_fedavg_rounds(
             round_log=round_log,
             checkpointer=checkpointer,
             checkpoint_every=checkpoint_every,
+            wire_quant=_qname if wire_quant is not None else None,
+            secure_agg=secure_agg,
         )
 
     if overlap:
@@ -547,12 +592,34 @@ def run_fedavg_rounds(
     quant_prev_delta = None
 
     me = None
+    sa_keys = None
+    sa_session = None
     if timings is not None:
         import time as _time
-
+    if timings is not None or secure_agg:
         from rayfed_tpu.runtime import get_runtime
 
-        me = get_runtime().party
+        _rt = get_runtime()
+        me = _rt.party
+    if secure_agg:
+        _transport = _rt.transport
+        sa_keys = getattr(_transport, "secagg_keys", None)
+        if sa_keys is None or not hasattr(
+            _transport, "ensure_secagg_peer_keys"
+        ):
+            raise ValueError(
+                "secure_agg needs the transport key-agreement plane "
+                "(TransportManager.secagg_keys) — this transport has "
+                "none"
+            )
+        # One HELLO ping per missing pair, before the first masked
+        # round (fl.secagg / transport.secagg).
+        _transport.ensure_secagg_peer_keys(parties)
+        # Fresh mask-seed scope per run, drawn identically on every
+        # controller: two runs in one process must never reuse a
+        # (session, stream, round) seed — reused keystream over
+        # different data is a two-time pad.
+        sa_session = str(_rt.next_seq_id())
 
     for r in range(start_round, rounds):
         active = round_parties(r)
@@ -654,6 +721,27 @@ def run_fedavg_rounds(
                     # headroom; what still clips rides the EF residual.
                     expand=_QUANT_DELTA_EXPAND,
                 )
+        # Secure aggregation: this party's round masker (pairwise
+        # seeds toward every active peer at its own fold weight); the
+        # keystream expansion prefetches on a background thread so it
+        # overlaps training/the wire instead of the round's critical
+        # path.  The bootstrap round (no grid) runs unmasked.
+        round_masker = None
+        if secure_agg and round_grid is not None and me in trainers:
+            from rayfed_tpu.fl import secagg as _sa
+            from rayfed_tpu.fl.fedavg import quant_weights
+
+            _iw, _ = quant_weights(
+                None if weights is None
+                else [float(w) for w in weights],
+                len(active),
+            )
+            round_masker = _sa.RoundMasker(
+                sa_keys, me, [p for p in active if p != me],
+                session=sa_session, stream="fedavg", round_index=r,
+                weight=_iw[active.index(me)],
+            )
+            round_masker.prefetch(round_grid.total_elems)
         if mode == "ring":
             from rayfed_tpu.fl.ring import (
                 RING_STATS,
@@ -707,6 +795,7 @@ def run_fedavg_rounds(
                 # Quantize the result broadcast too: the downlink is
                 # the other half of the round's bytes.
                 quant_downlink=round_grid is not None,
+                secagg=round_masker,
             )
         else:
             t_a0 = _time.perf_counter() if rec is not None else 0.0

@@ -36,6 +36,9 @@ _INTERNAL_ALLOWED = {
     # the coded skeleton carries the class + its static grid descriptor.
     ("rayfed_tpu.fl.quantize", "QuantizedPackedTree"),
     ("rayfed_tpu.fl.quantize", "QuantMeta"),
+    # Secure aggregation: the masked wire form (i32 codes on the shared
+    # grid — rayfed_tpu.fl.secagg).
+    ("rayfed_tpu.fl.secagg", "MaskedCodeTree"),
     ("jax._src.tree_util", "default_registry"),
 }
 

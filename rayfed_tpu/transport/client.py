@@ -234,6 +234,7 @@ class TransportClient:
         loop: Optional[asyncio.AbstractEventLoop] = None,
         stripe_rails: Optional[int] = None,
         dead_check: Optional[Any] = None,
+        secagg: Optional[Any] = None,
     ) -> None:
         if checksum is None:
             # Match the manager's policy: checksum only when the fast C++
@@ -275,6 +276,11 @@ class TransportClient:
         # Version advertised in the connection HELLO handshake —
         # overridable so tests can exercise the mismatch path.
         self._proto_version = wire.WIRE_FORMAT_VERSION
+        # Secure-aggregation key agreement (transport/secagg.py): when
+        # set, every HELLO this client opens publishes the local key
+        # advertisement and records the server's from the reply — one
+        # connection establishes the pair's mask-seed state both ways.
+        self._secagg = secagg
         self._conns: List[_Conn] = []
         self._conn_lock = asyncio.Lock()
         self._pool_size = max(1, int(pool_size))
@@ -367,13 +373,20 @@ class TransportClient:
         # ProtocolMismatchError naming both versions, instead of a
         # confusing manifest-decode error mid-payload.
         try:
-            await self._roundtrip(
+            hello = {"src": self._src_party, "ver": self._proto_version}
+            if self._secagg is not None:
+                hello[wire.SECAGG_PUB_KEY] = self._secagg.hello_value()
+            reply = await self._roundtrip(
                 wire.MSG_HELLO,
-                {"src": self._src_party, "ver": self._proto_version},
+                hello,
                 [],
                 timeout_s=min(self._timeout_s, 15.0),
                 conn=conn,
             )
+            if self._secagg is not None:
+                peer_adv = reply.get(wire.SECAGG_PUB_KEY)
+                if peer_adv:
+                    self._secagg.record_peer(self._dest_party, peer_adv)
         except BaseException:
             if conn.reader_task is not None:
                 conn.reader_task.cancel()

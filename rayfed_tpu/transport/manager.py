@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Sequence
 
 from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
 from rayfed_tpu.executor import LocalRef
+from rayfed_tpu.transport import secagg as secagg_keys
 from rayfed_tpu.transport import tls as tls_utils
 from rayfed_tpu.transport import wire
 from rayfed_tpu.transport.client import SendError, TransportClient
@@ -296,6 +297,13 @@ class TransportManager:
         self._membership_inbox: "_collections.deque" = _collections.deque()
         self._server.epoch_provider = lambda: self.roster.epoch
         self._server._observers.append(self._observe_membership)
+        # Secure-aggregation key agreement (transport/secagg.py): one
+        # ephemeral keypair per manager (per fed.init session), NOT
+        # module-global — several in-process parties each hold their
+        # own.  Published in every HELLO this party sends or answers;
+        # fl/secagg.py derives pairwise mask seeds from it.
+        self.secagg_keys = secagg_keys.KeyAgreement(self._party)
+        self._server.secagg = self.secagg_keys
         # Set by api.init: () -> Optional[jax.sharding.Mesh].  Received
         # shard-encoded leaves whose sender sharding fits this mesh are
         # device_put with the equivalent local NamedSharding.
@@ -734,6 +742,7 @@ class TransportManager:
                         lambda p=dest_party:
                         p in self._mailbox.dead_parties_snapshot()
                     ),
+                    secagg=self.secagg_keys,
                 )
                 self._clients[dest_party] = client
             return client
@@ -1229,6 +1238,42 @@ class TransportManager:
         except Exception:
             return False
 
+    def ensure_secagg_peer_keys(
+        self, parties: Sequence[str], timeout_s: float = 30.0
+    ) -> None:
+        """Establish the pairwise secure-aggregation key state with
+        every listed peer before the first masked round.
+
+        Key agreement rides the connection HELLO (``wire.
+        SECAGG_PUB_KEY``), so one successful ping per missing pair is
+        enough: our HELLO hands the peer our key, its reply hands us
+        its.  Peers whose keys are already recorded cost nothing.
+        Raises :class:`~rayfed_tpu.transport.secagg.SecAggError` naming
+        every peer still missing at the deadline — masks derived
+        without the pair state could never cancel.
+        """
+        deadline = time.monotonic() + float(timeout_s)
+        missing = [
+            p for p in parties
+            if p != self._party and not self.secagg_keys.has_peer(p)
+        ]
+        while missing:
+            for p in list(missing):
+                if self.ping(p, timeout_s=2.0) and (
+                    self.secagg_keys.has_peer(p)
+                ):
+                    missing.remove(p)
+            if not missing:
+                return
+            if time.monotonic() >= deadline:
+                raise secagg_keys.SecAggError(
+                    f"[{self._party}] no secure-aggregation key from "
+                    f"{sorted(missing)} after {timeout_s:.0f}s — the "
+                    f"peers are unreachable or run a build without the "
+                    f"secagg HELLO advertisement"
+                )
+            time.sleep(0.2)
+
     def get_stats(self) -> Dict[str, Any]:
         stats = dict(self.stats)
         stats.update(self._server.stats)
@@ -1288,4 +1333,8 @@ class TransportManager:
         # Snapshot, not the live dict: get_stats runs on user threads
         # while the loop-thread health monitor mutates the dead set.
         stats["dead_parties"] = sorted(self._mailbox.dead_parties_snapshot())
+        # Secure-aggregation key-agreement state: this party's suite and
+        # which peers have completed the HELLO key exchange (the
+        # operator's "why can't these two mask" diagnostic).
+        stats["secagg"] = self.secagg_keys.describe()
         return stats

@@ -476,14 +476,23 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 # Flush the reply, then drop the connection.
                 asyncio.get_running_loop().call_soon(self._abort)
                 return
-            self._reply(
-                wire.MSG_HELLO,
-                {
-                    "rid": header.get("rid"),
-                    "ver": wire.WIRE_FORMAT_VERSION,
-                    "src": server._party,
-                },
-            )
+            reply = {
+                "rid": header.get("rid"),
+                "ver": wire.WIRE_FORMAT_VERSION,
+                "src": server._party,
+            }
+            # Secure-aggregation key agreement rides the handshake
+            # (transport/secagg.py): record the client's advertised key
+            # and answer with our own, so one connection establishes
+            # the pair's mask-seed state in both directions.
+            sa = server.secagg
+            if sa is not None:
+                peer_adv = header.get(wire.SECAGG_PUB_KEY)
+                src = header.get("src")
+                if peer_adv and src:
+                    sa.record_peer(str(src), peer_adv)
+                reply[wire.SECAGG_PUB_KEY] = sa.hello_value()
+            self._reply(wire.MSG_HELLO, reply)
             return
         if msg_type == wire.MSG_PING:
             self._reply(wire.MSG_PONG, {"rid": header.get("rid")})
@@ -1201,6 +1210,10 @@ class TransportServer:
         # current roster epoch.  Frames stamped with a different epoch
         # (wire.EPOCH_TAG_KEY) are rejected loudly.  Set by the manager.
         self.epoch_provider: Optional[Callable[[], Optional[int]]] = None
+        # Secure-aggregation key agreement (transport/secagg.py): when
+        # set by the manager, inbound HELLOs have their key
+        # advertisement recorded and the HELLO reply carries ours.
+        self.secagg: Optional[Any] = None
         self._warned_no_native_crc = False
         self.stats: Dict[str, Any] = {"receive_op_count": 0, "receive_bytes": 0}
         # Per-party monotonically growing byte counters INCLUDING bytes

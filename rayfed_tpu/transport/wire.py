@@ -150,6 +150,23 @@ EPOCH_TAG_KEY = "ep"
 # tool/check_wire_format.py.
 QUANT_GRID_KEY = "qg"
 
+# Header key of the connection HELLO handshake carrying the sender's
+# SECURE-AGGREGATION key advertisement (transport/secagg.py): a compact
+# ``"<version>.<kex>.<prg>.<hex key>"`` string — an ephemeral X25519
+# public key (or the stdlib fallback's per-session nonce) plus the mask
+# PRG suite.  The client publishes its value in the HELLO it opens every
+# connection with, the server records it and replies with its own, so
+# ONE ping per pair establishes the pairwise mask-seed state in both
+# directions with zero extra round trips and zero payload bytes (masks
+# are generated from derived seeds, never transmitted —
+# fl/secagg.py).  Absent on builds that never enable secure
+# aggregation is fine: the value is opportunistic, and the loud failure
+# lives at mask time.  Rides the HELLO header beside ``ver``/``src`` —
+# NO frame-layout change, but the key name AND the value format version
+# (``transport.secagg.SECAGG_VERSION``) are cross-party contracts,
+# fingerprinted by tool/check_wire_format.py.
+SECAGG_PUB_KEY = "sapk"
+
 
 def pack_frame(
     msg_type: int,

@@ -52,7 +52,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # (the donated-i32 integer fold must beat dequantize-first),
 # compressed_agg_bitexact (streamed integer fold == one-shot
 # packed_quantized_sum) and compressed_loss_ratio <= 1.05 (8-bit+EF
-# converges with f32 — equal converged accuracy), and the CHAOS gate:
+# converges with f32 — equal converged accuracy), the SECURE-AGGREGATION
+# gates: secagg_bitexact (the pairwise-masked round's aggregate is
+# BYTE-identical to the plain quantized round's — masks cancel in the
+# integer ring, never approximately) and secagg_overhead_frac <= 0.05
+# (masks ride zero wire bytes and the keystream prefetch hides under
+# the local step, so masking costs at most 5% of a realistic round),
+# and the CHAOS gate:
 # under a
 # seeded schedule injecting 1 straggler past the round deadline, 1
 # hard party crash at N=4, AND a hard kill of the COORDINATOR between

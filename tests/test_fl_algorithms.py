@@ -20,7 +20,7 @@ from rayfed_tpu.fl import (
     tree_average,
     unmask_sum,
 )
-from rayfed_tpu.fl.secure import pairwise_key
+from rayfed_tpu.fl.secagg import pairwise_key
 
 
 def _params():

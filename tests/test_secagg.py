@@ -1,0 +1,838 @@
+"""Secure aggregation: key agreement, masked integer folds, dropout
+recovery (fl.secagg + transport.secagg).
+
+In-process units cover the subsystem's math and contracts (seed
+derivation, PRG determinism, mask cancellation under shuffled fold
+orders, the i32/mod-2³² headroom story, recovery corrections, the
+HELLO key exchange over real sockets).  Two multiprocess integrations:
+a fault-free parity run asserting masked == unmasked bytes on BOTH the
+streaming and quorum paths (and quantized-quorum == quantized-streaming
+— the composition the quant= threading exists for), and ONE chaos e2e
+(N=4, quorum=2, toy model): a straggler past the deadline plus a hard
+crash trigger mask recovery mid-round, a coordinator kill in the
+recovery window reaches the failover arm, and the survivors byte-agree.
+
+X25519/AES paths need the optional ``cryptography`` package and skip
+LOUDLY when it is absent (like the TLS tests); the stdlib fallback
+(group key + Philox) is exercised everywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.fl import compression as fl_comp
+from rayfed_tpu.fl import fedavg as fl_fedavg
+from rayfed_tpu.fl import quantize as qz
+from rayfed_tpu.fl import secagg as sa
+from rayfed_tpu.fl.streaming import StreamingAggregator
+from rayfed_tpu.transport.secagg import HAVE_X25519, KeyAgreement
+
+from .multiproc import get_free_ports, make_cluster, run_parties
+
+GROUP_KEY = b"test-secagg-group-key"
+PARTIES = ["alice", "bob", "carol", "dave"]
+
+
+def _keyring(parties=PARTIES, group_key=GROUP_KEY):
+    """Cross-recorded KeyAgreement instances, as HELLO would leave them."""
+    keys = {p: KeyAgreement(p, group_key=group_key) for p in parties}
+    for p in parties:
+        for q in parties:
+            if p != q:
+                keys[p].record_peer(q, keys[q].hello_value())
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# Key agreement + seed derivation
+# ---------------------------------------------------------------------------
+
+
+def test_pair_seed_symmetric_and_scoped():
+    keys = _keyring()
+    kw = dict(session="s1", stream="fedavg", round_index=3)
+    ab = keys["alice"].pair_seed("bob", **kw)
+    ba = keys["bob"].pair_seed("alice", **kw)
+    # Order-independent: both endpoints derive the identical seed.
+    assert ab == ba and len(ab) == 32
+    # ...and every scope component re-keys it (round, stream, session):
+    # failover attempts and repeated runs never reuse a keystream.
+    assert ab != keys["alice"].pair_seed(
+        "bob", session="s1", stream="fedavg", round_index=4
+    )
+    assert ab != keys["alice"].pair_seed(
+        "bob", session="s1", stream="fedavg.fo.bob", round_index=3
+    )
+    assert ab != keys["alice"].pair_seed(
+        "bob", session="s2", stream="fedavg", round_index=3
+    )
+    # Distinct pairs get distinct seeds.
+    assert ab != keys["alice"].pair_seed("carol", **kw)
+
+
+def test_length_prefixed_preimage_no_cross_pair_collision():
+    """Party names (and scope strings) are length-prefixed into every
+    derivation preimage: concatenation-colliding tuples must not share
+    seeds (('a','b|c') vs ('a|b','c') was the seed-era footgun)."""
+    for pair_a, pair_b in [
+        (("a", "b|c"), ("a|b", "c")),
+        (("ab", "c"), ("a", "bc")),
+    ]:
+        ka = _keyring(list(pair_a))
+        kb = _keyring(list(pair_b))
+        sa_a = ka[pair_a[0]].pair_seed(
+            pair_a[1], session="s", stream="f", round_index=0
+        )
+        sa_b = kb[pair_b[0]].pair_seed(
+            pair_b[1], session="s", stream="f", round_index=0
+        )
+        assert sa_a != sa_b
+    # Scope-boundary shifting must re-key too ("ab"+"c" vs "a"+"bc"
+    # across the stream/session boundary).
+    keys = _keyring()
+    s1 = keys["alice"].pair_seed(
+        "bob", session="xy", stream="z", round_index=0
+    )
+    s2 = keys["alice"].pair_seed(
+        "bob", session="x", stream="yz", round_index=0
+    )
+    assert s1 != s2
+
+
+def test_missing_peer_and_group_key_fail_loudly():
+    lone = KeyAgreement("alice", group_key=GROUP_KEY)
+    with pytest.raises(sa.SecAggError, match="no secure-aggregation key"):
+        lone.pair_secret("bob")
+    if not HAVE_X25519:
+        # Stdlib fallback without a provisioned group key: loud, with
+        # the remedy in the message.
+        a = KeyAgreement("alice", group_key=None)
+        b = KeyAgreement("bob", group_key=None)
+        a.record_peer("bob", b.hello_value())
+        with pytest.raises(sa.SecAggError, match="group key"):
+            a.pair_secret("bob")
+
+
+def test_malformed_hello_values_ignored():
+    a = KeyAgreement("alice", group_key=GROUP_KEY)
+    for bad in ("", "junk", "9999.x25519.aes." + "ff" * 32, "1.x.y.zz"):
+        a.record_peer("bob", bad)
+    assert not a.has_peer("bob")
+    # Own advertisement is never recorded as a peer.
+    a.record_peer("alice", a.hello_value())
+    assert not a.has_peer("alice")
+
+
+def test_rekeyed_peer_invalidates_pair_secret():
+    keys = _keyring(["alice", "bob"])
+    s1 = keys["alice"].pair_seed(
+        "bob", session="s", stream="f", round_index=0
+    )
+    fresh_bob = KeyAgreement("bob", group_key=GROUP_KEY)
+    keys["alice"].record_peer("bob", fresh_bob.hello_value())
+    fresh_bob.record_peer("alice", keys["alice"].hello_value())
+    s2 = keys["alice"].pair_seed(
+        "bob", session="s", stream="f", round_index=0
+    )
+    assert s1 != s2
+    assert s2 == fresh_bob.pair_seed(
+        "alice", session="s", stream="f", round_index=0
+    )
+
+
+@pytest.mark.skipif(
+    not HAVE_X25519,
+    reason="SKIPPED LOUDLY: 'cryptography' not installed — the X25519 "
+    "key-agreement path is untested on this build (stdlib nonce "
+    "fallback is covered; pip install 'rayfed-tpu[secagg]')",
+)
+def test_x25519_pair_needs_no_group_key():
+    keys = {p: KeyAgreement(p, group_key=None) for p in ("alice", "bob")}
+    keys["alice"].record_peer("bob", keys["bob"].hello_value())
+    keys["bob"].record_peer("alice", keys["alice"].hello_value())
+    kw = dict(session="s", stream="f", round_index=0)
+    assert keys["alice"].pair_seed("bob", **kw) == keys["bob"].pair_seed(
+        "alice", **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# PRG
+# ---------------------------------------------------------------------------
+
+
+def test_prg_deterministic_and_seed_separated():
+    seed1, seed2 = b"\x01" * 32, b"\x02" * 32
+    a1 = sa.prg_mask(seed1, 4096)
+    assert a1.dtype == np.uint32 and a1.shape == (4096,)
+    # Deterministic across calls and a prefix of a longer expansion
+    # would NOT necessarily hold (counter blocks) — only exact-call
+    # determinism is the contract both endpoints rely on.
+    np.testing.assert_array_equal(a1, sa.prg_mask(seed1, 4096))
+    assert not np.array_equal(a1, sa.prg_mask(seed2, 4096))
+    # Short seeds are rejected (a truncated seed would silently shrink
+    # the keyspace).
+    with pytest.raises(sa.SecAggError, match="32-byte seed"):
+        sa.prg_mask(b"short", 16)
+
+
+# ---------------------------------------------------------------------------
+# Masked folds
+# ---------------------------------------------------------------------------
+
+N_ELEMS = 5000
+CHUNK = 1024
+
+
+def _round_fixture(weights, n=N_ELEMS, parties=PARTIES):
+    tree = {"w": jnp.arange(n, dtype=jnp.float32) * 1e-4}
+    packed = fl_comp.compress(tree, packed=True, wire_dtype=jnp.float32)
+    ref = np.asarray(packed.buf).astype(np.float32)
+    grid = qz.make_round_grid(
+        (1e-3 * np.random.default_rng(0).standard_normal(n)).astype(
+            np.float32
+        ),
+        mode="delta", chunk_elems=CHUNK,
+    )
+    ups = {
+        p: fl_comp.PackedTree(
+            ref
+            + (1e-3 * np.random.default_rng(i).standard_normal(n)).astype(
+                np.float32
+            ),
+            packed.passthrough,
+            fl_comp.PackSpec(
+                packed.spec.entries, packed.spec.treedef, "float32"
+            ),
+        )
+        for i, p in enumerate(parties)
+    }
+    qts = {p: qz.quantize_packed(ups[p], grid, ref=ref) for p in parties}
+    return grid, ref, ups, qts
+
+
+def _masked(keys, grid, ref, ups, wmap, r=1, stream="f", parties=PARTIES,
+            self_mask=False):
+    out, maskers = {}, {}
+    for p in parties:
+        m = sa.RoundMasker(
+            keys[p], p, [q for q in parties if q != p],
+            session="s", stream=stream, round_index=r,
+            weight=int(wmap[p]), self_mask=self_mask,
+        )
+        out[p] = sa.MaskedRoundCodec(grid, ref, None, m).to_wire(ups[p])
+        maskers[p] = m
+    return out, maskers
+
+
+@pytest.mark.parametrize("weights", [None, [2.0, 1.0, 3.0, 1.0]])
+def test_masked_fold_bitexact_shuffled_orders(weights):
+    """THE acceptance gate in unit form: the masked aggregate is
+    BYTE-identical to the unmasked round's, whatever order the
+    contributions fold in (integer adds mod 2³² are exact and
+    order-free; every pair mask meets its negative)."""
+    keys = _keyring()
+    grid, ref, ups, qts = _round_fixture(weights)
+    w_list = weights
+    wmap = dict(zip(PARTIES, weights or [1] * len(PARTIES)))
+    want = fl_fedavg.packed_quantized_sum(
+        [qts[p] for p in PARTIES], w_list, ref=ref
+    )
+    mts, _ = _masked(keys, grid, ref, ups, wmap)
+    for trial in range(3):
+        agg = StreamingAggregator(
+            len(PARTIES), weights=w_list, quant=grid, quant_ref=ref,
+            chunk_elems=CHUNK, masked=True, labels=PARTIES,
+        )
+        order = list(range(len(PARTIES)))
+        random.Random(trial).shuffle(order)
+        for i in order:
+            agg.add_local(i, mts[PARTIES[i]])
+        res = agg.result(timeout=60)
+        np.testing.assert_array_equal(
+            np.asarray(res.buf), np.asarray(want.buf)
+        )
+
+
+def test_masked_fold_headroom_edge_mod_2_32():
+    """The i32/mod-2³² headroom story: masked intermediates wrap freely,
+    but once the masks cancel the residual is the true Σw·q — exact up
+    to the grid's headroom bound, byte-identical to the unmasked fold
+    even with the weighted code sum pushed near 2³¹."""
+    parties = ["alice", "bob"]
+    keys = _keyring(parties)
+    # Weights near the uint8 headroom ceiling (2³¹−1)/255 ≈ 8.42e6.
+    weights = [4_200_000.0, 4_000_000.0]
+    grid, ref, ups, qts = _round_fixture(weights, n=2048, parties=parties)
+    # Saturate the codes high: values far past the grid range clip to
+    # qmax=255, so Σw·q ≈ 2.09e9 — wrapping distance from 2³¹.
+    hot = {
+        p: fl_comp.PackedTree(
+            ref + 1.0, ups[p].passthrough, ups[p].spec
+        )
+        for p in parties
+    }
+    qts = {p: qz.quantize_packed(hot[p], grid, ref=ref) for p in parties}
+    grid.check_weight_headroom(sum(int(w) for w in weights))
+    with pytest.raises(ValueError, match="integer-fold overflow"):
+        grid.check_weight_headroom(9_000_000)
+    want = fl_fedavg.packed_quantized_sum(
+        [qts[p] for p in parties], weights, ref=ref
+    )
+    wmap = dict(zip(parties, weights))
+    mts, _ = _masked(keys, grid, ref, hot, wmap, parties=parties)
+    agg = StreamingAggregator(
+        2, weights=weights, quant=grid, quant_ref=ref,
+        chunk_elems=CHUNK, masked=True, labels=parties,
+    )
+    agg.add_local(1, mts["bob"])
+    agg.add_local(0, mts["alice"])
+    res = agg.result(timeout=60)
+    np.testing.assert_array_equal(np.asarray(res.buf), np.asarray(want.buf))
+
+
+def test_dropout_recovery_correction_bitexact():
+    """Quorum cutoff with a dropped party: the survivors' seeds expand
+    exactly the orphaned masks (pairwise + the members' self-masks),
+    and the corrected fold equals the unmasked subset fold
+    byte-for-byte."""
+    keys = _keyring()
+    weights = [2.0, 1.0, 3.0, 1.0]
+    wmap = dict(zip(PARTIES, weights))
+    grid, ref, ups, qts = _round_fixture(weights)
+    mts, maskers = _masked(keys, grid, ref, ups, wmap, r=2,
+                           self_mask=True)
+    members = PARTIES[:3]  # dave drops
+    recoveries = []
+
+    def hook(member_labels):
+        assert member_labels == members
+        dropped = sorted(set(PARTIES) - set(member_labels))
+        seeds = {
+            p: maskers[p].recovery_seeds(dropped) for p in member_labels
+        }
+        recoveries.append(dropped)
+        return sa.mask_correction(
+            seeds, dropped, N_ELEMS, keys["alice"].prg_scheme,
+            members=member_labels,
+            self_seeds={
+                p: maskers[p].self_seed_hex() for p in member_labels
+            },
+        )
+
+    agg = StreamingAggregator(
+        4, weights=weights, quant=grid, quant_ref=ref, chunk_elems=CHUNK,
+        masked=True, labels=PARTIES, quorum=3, mask_recovery=hook,
+    )
+    for i, p in enumerate(members):
+        agg.add_local(i, mts[p])
+    res = agg.result(timeout=60, deadline_s=0.5)
+    want = fl_fedavg.packed_quantized_sum(
+        [qts[p] for p in members], weights[:3], ref=ref
+    )
+    np.testing.assert_array_equal(np.asarray(res.buf), np.asarray(want.buf))
+    assert recoveries == [["dave"]]
+    assert agg.quorum_members == [0, 1, 2]
+
+
+def test_passthrough_leaves_refused_unmasked():
+    """Non-float (passthrough) leaves live off the packed buffer where
+    no mask can cover them — shipping them in the clear would quietly
+    break the sum-only guarantee, so the codec refuses loudly."""
+    keys = _keyring(["alice", "bob"])
+    grid, ref, ups, _ = _round_fixture(None, parties=["alice", "bob"])
+    packed = fl_comp.compress(
+        {"w": jnp.arange(N_ELEMS, dtype=jnp.float32) * 1e-4,
+         "step": jnp.asarray(np.int32(7))},
+        packed=True, wire_dtype=jnp.float32,
+    )
+    assert packed.passthrough  # the int leaf rides outside the buffer
+    m = sa.RoundMasker(
+        keys["alice"], "alice", ["bob"], session="s", stream="f",
+        round_index=0,
+    )
+    with pytest.raises(sa.SecAggError, match="UNMASKED"):
+        sa.MaskedRoundCodec(grid, ref, None, m).to_wire(packed)
+
+
+def test_excluded_straggler_stays_noise_after_recovery():
+    """The Bonawitz straggler attack is CLOSED by double-masking: even
+    with every pairwise seed toward an excluded-but-alive party
+    recovered (which the dropout protocol necessarily reveals), its
+    late-arriving masked payload minus everything the coordinator can
+    reconstruct still differs by PRG(b) — private randomness nobody
+    else holds."""
+    keys = _keyring()
+    weights = [1.0] * 4
+    wmap = dict(zip(PARTIES, weights))
+    grid, ref, ups, qts = _round_fixture(weights)
+    mts, maskers = _masked(keys, grid, ref, ups, wmap, r=3,
+                           self_mask=True)
+    straggler = "dave"
+    members = [p for p in PARTIES if p != straggler]
+    # Everything an honest-but-curious coordinator holds after
+    # recovery: the straggler's late payload, its quantized codes'
+    # domain (worst case: assume it even knows w·q), and the pairwise
+    # seeds of every (member, straggler) pair.
+    known = np.zeros(N_ELEMS, np.uint32)
+    for p in members:
+        seed = maskers[p].recovery_seeds([straggler])[straggler]
+        ks = sa.prg_mask(
+            bytes.fromhex(seed), N_ELEMS, keys[p].prg_scheme
+        )
+        # Reconstruct the straggler's own signs toward each member.
+        if straggler < p:
+            known += ks
+        else:
+            known -= ks
+    leaked = (
+        np.asarray(mts[straggler].buf).view(np.uint32)
+        - np.asarray(qts[straggler].buf).astype(np.int64).astype(
+            np.uint32
+        )
+        - known
+    )
+    # What remains is exactly PRG(b) — uniform noise, not zeros.
+    want_b = sa.prg_mask(
+        bytes.fromhex(maskers[straggler].self_seed_hex()), N_ELEMS,
+        keys[straggler].prg_scheme,
+    )
+    np.testing.assert_array_equal(leaked, want_b)
+    assert np.count_nonzero(leaked) > N_ELEMS * 0.99
+    # ...and b is fresh private randomness per masker, never derived
+    # from shared state.
+    other = sa.RoundMasker(
+        keys[straggler], straggler, members, session="s", stream="f",
+        round_index=3, self_mask=True,
+    )
+    assert other.self_seed_hex() != maskers[straggler].self_seed_hex()
+    # The streaming (all-of-n) masker carries no self-mask and says so.
+    with pytest.raises(sa.SecAggError, match="no self-mask"):
+        maskers_plain = sa.RoundMasker(
+            keys["alice"], "alice", ["bob"], session="s", stream="f",
+            round_index=0,
+        )
+        maskers_plain.self_seed_hex()
+
+
+def test_mask_correction_survivor_coverage_validated():
+    """A mis-keyed or missing survivor must abort the correction, not
+    silently skip: signs derive from the party names."""
+    keys = _keyring(["alice", "bob", "carol"])
+    maskers = {
+        p: sa.RoundMasker(
+            keys[p], p, [q for q in ("alice", "bob", "carol") if q != p],
+            session="s", stream="f", round_index=0,
+        )
+        for p in ("alice", "bob")
+    }
+    seeds = {p: m.recovery_seeds(["carol"]) for p, m in maskers.items()}
+    ok = sa.mask_correction(
+        seeds, ["carol"], 16, keys["alice"].prg_scheme,
+        members=["alice", "bob"],
+    )
+    assert ok.shape == (16,)
+    with pytest.raises(sa.SecAggError, match="pinned member set"):
+        sa.mask_correction(
+            {"alice": seeds["alice"]}, ["carol"], 16,
+            keys["alice"].prg_scheme, members=["alice", "bob"],
+        )
+
+
+def test_mask_correction_missing_seed_fails_loudly():
+    keys = _keyring(["alice", "bob", "carol"])
+    m = sa.RoundMasker(
+        keys["alice"], "alice", ["bob", "carol"],
+        session="s", stream="f", round_index=0,
+    )
+    seeds = {"alice": m.recovery_seeds(["carol"])}
+    with pytest.raises(sa.SecAggError, match="no seed toward"):
+        sa.mask_correction({"alice": {}, "bob": {}}, ["carol"], 16)
+    # ...and a complete map works.
+    corr = sa.mask_correction(seeds, ["carol"], 16, keys["alice"].prg_scheme)
+    assert corr.dtype == np.uint32 and corr.shape == (16,)
+
+
+def test_masked_unmasked_mode_guards():
+    keys = _keyring()
+    grid, ref, ups, qts = _round_fixture(None)
+    wmap = {p: 1 for p in PARTIES}
+    mts, _ = _masked(keys, grid, ref, ups, wmap)
+    # Unmasked tree into a masked fold: loud.
+    agg = StreamingAggregator(
+        4, quant=grid, quant_ref=ref, chunk_elems=CHUNK, masked=True
+    )
+    agg.add_local(0, qts["alice"])
+    with pytest.raises(TypeError, match="unmasked contribution"):
+        agg.result(timeout=10)
+    # Masked tree into a plain quantized fold: loud.
+    agg2 = StreamingAggregator(
+        4, quant=grid, quant_ref=ref, chunk_elems=CHUNK
+    )
+    agg2.add_local(0, mts["alice"])
+    with pytest.raises(TypeError, match="MaskedCodeTree"):
+        agg2.result(timeout=10)
+    # masked=True without a grid: the masks have no integer domain.
+    with pytest.raises(ValueError, match="masked aggregation requires"):
+        StreamingAggregator(2, masked=True)
+    with pytest.raises(ValueError, match="mask_recovery"):
+        StreamingAggregator(2, mask_recovery=lambda m: None)
+
+
+def test_masked_tree_refuses_decode_and_roundtrips_wire():
+    from rayfed_tpu.transport import wire
+
+    keys = _keyring(["alice", "bob"])
+    grid, ref, ups, _ = _round_fixture(None, parties=["alice", "bob"])
+    m = sa.RoundMasker(
+        keys["alice"], "alice", ["bob"], session="s", stream="f",
+        round_index=0,
+    )
+    mt = sa.MaskedRoundCodec(grid, ref, None, m).to_wire(ups["alice"])
+    assert np.asarray(mt.buf).dtype == np.int32
+    with pytest.raises(sa.SecAggError, match="ring noise"):
+        mt.dequantize(np.float32, ref=ref)
+    with pytest.raises(sa.SecAggError):
+        mt.unpack()
+    bufs = wire.encode_payload(mt)
+    blob = b"".join(
+        bytes(b.produce()) if isinstance(b, wire.LazyBuffer) else bytes(b)
+        for b in bufs
+    )
+    back = wire.decode_payload(blob)
+    assert isinstance(back, sa.MaskedCodeTree)
+    np.testing.assert_array_equal(np.asarray(back.buf), np.asarray(mt.buf))
+    assert back.gmeta == mt.gmeta
+
+
+def test_recovery_message_schema_validation():
+    req = sa.make_recovery_request(["b", "a"], ["c"])
+    assert req["m"] == ["a", "b"] and req["dr"] == ["c"]
+    assert sa.check_recovery_message(req, "request") is req
+    rep = sa.make_recovery_reply("a", {"c": "00" * 32}, "11" * 32)
+    assert sa.check_recovery_message(rep, "reply") is rep
+    with pytest.raises(sa.SecAggError, match="missing field"):
+        sa.check_recovery_message({"v": 1, "m": []}, "request")
+    with pytest.raises(sa.SecAggError, match="schema v99"):
+        sa.check_recovery_message({"v": 99, "m": [], "dr": []}, "request")
+    with pytest.raises(sa.SecAggError, match="non-integer version"):
+        sa.check_recovery_message(
+            {"v": "2.x", "m": [], "dr": []}, "request"
+        )
+    with pytest.raises(sa.SecAggError, match="not a hex seed"):
+        sa.mask_correction(
+            {"a": {"c": "zz"}}, ["c"], 8, members=["a"],
+        )
+
+
+def test_trainer_validation_matrix():
+    from rayfed_tpu.fl.trainer import run_fedavg_rounds
+
+    trainers = {"alice": object(), "bob": object()}
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    with pytest.raises(ValueError, match="secure_agg requires wire_quant"):
+        run_fedavg_rounds(
+            trainers, params, 1, compress_wire=True, packed_wire=True,
+            streaming_agg=True, secure_agg=True,
+        )
+    with pytest.raises(ValueError, match="mode='ring'"):
+        run_fedavg_rounds(
+            trainers, params, 1, compress_wire=True, packed_wire=True,
+            mode="ring", wire_quant="uint8", secure_agg=True,
+        )
+    with pytest.raises(ValueError, match="secure_agg and sample"):
+        run_fedavg_rounds(
+            trainers, params, 1, compress_wire=True, packed_wire=True,
+            streaming_agg=True, wire_quant="uint8", secure_agg=True,
+            sample=1,
+        )
+    # Satellite: the wire_quant × quorum exclusion is LIFTED...
+    with pytest.raises(ValueError, match="ring"):
+        # ...but quorum + ring + quant stays a loud exclusion.
+        run_fedavg_rounds(
+            trainers, params, 1, compress_wire=True, packed_wire=True,
+            mode="ring", wire_quant="uint8", quorum=2,
+            round_deadline_s=5.0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# HELLO key exchange over real transport
+# ---------------------------------------------------------------------------
+
+
+def test_hello_key_exchange_over_transport():
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.transport.manager import TransportManager
+
+    pa, pb = get_free_ports(2)
+    ports = {"alice": pa, "bob": pb}
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                p: PartyConfig.from_dict(
+                    {"address": f"127.0.0.1:{port}"}
+                )
+                for p, port in ports.items()
+            },
+            current_party=party,
+        )
+        return TransportManager(
+            cc, JobConfig(device_put_received=False)
+        )
+
+    a, b = mk("alice"), mk("bob")
+    a.start()
+    b.start()
+    try:
+        assert not a.secagg_keys.has_peer("bob")
+        # ONE ping establishes the pair in BOTH directions: our HELLO
+        # hands bob our key, its reply hands us its.
+        a.ensure_secagg_peer_keys(["bob"], timeout_s=20)
+        assert a.secagg_keys.has_peer("bob")
+        assert b.secagg_keys.has_peer("alice")
+        st = a.get_stats()["secagg"]
+        assert "bob" in st["peers"]
+        assert st["kex"] in ("x25519", "nonce")
+        # With a shared group key the pair can now derive seeds.
+        a.secagg_keys.set_group_key(GROUP_KEY)
+        b.secagg_keys.set_group_key(GROUP_KEY)
+        kw = dict(session="s", stream="f", round_index=0)
+        assert a.secagg_keys.pair_seed("bob", **kw) == (
+            b.secagg_keys.pair_seed("alice", **kw)
+        )
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Integration: parity (streaming + quorum) and THE chaos e2e
+# ---------------------------------------------------------------------------
+
+DIM = 2048
+DELTAS = {"alice": 0.25, "bob": 0.5, "carol": 1.0, "dave": 2.0}
+
+
+def _define_trainers(fed, parties):
+    @fed.remote
+    class Trainer:
+        def __init__(self, delta):
+            self._d = float(delta)
+
+        def train(self, params):
+            from rayfed_tpu.fl import compression as C
+
+            tree = C.decompress(params, jnp.float32)
+            out = {"w": tree["w"] + self._d * 1e-2}
+            return C.compress(out, packed=True, wire_dtype=jnp.float32)
+
+    return {p: Trainer.party(p).remote(DELTAS[p]) for p in parties}
+
+
+def _run_secagg_parity(party, cluster, outdir):
+    os.environ["RAYFED_SECAGG_GROUP_KEY"] = "parity-test-key"
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    fed.init(
+        address="local", cluster=cluster, party=party,
+        enable_waiting_for_other_parties_ready=True,
+        recv_backstop_in_seconds=120,
+    )
+    trainers = _define_trainers(fed, list(cluster))
+    params = {
+        "w": jnp.linspace(-1.0, 1.0, DIM).astype(jnp.float32)
+    }
+    n = len(cluster)
+    finals = {}
+    for name, kwargs in [
+        ("stream_plain", dict(streaming_agg=True)),
+        ("stream_secure", dict(streaming_agg=True, secure_agg=True)),
+        ("quorum_plain", dict(quorum=n, round_deadline_s=60.0)),
+        ("quorum_secure", dict(
+            quorum=n, round_deadline_s=60.0, secure_agg=True,
+        )),
+    ]:
+        # Fresh EF state per run: the four recurrences must see
+        # identical inputs to land on identical bytes.
+        qz.reset_compressors()
+        finals[name] = run_fedavg_rounds(
+            trainers, params, rounds=3, compress_wire=True,
+            packed_wire=True, wire_dtype=jnp.float32,
+            wire_quant="uint8", **kwargs,
+        )
+    from rayfed_tpu.fl.secagg import SECAGG_STATS
+
+    report = {
+        name: np.asarray(v["w"], dtype=np.float32).tobytes().hex()
+        for name, v in finals.items()
+    }
+    report["masked_rounds"] = SECAGG_STATS["masked_rounds"]
+    with open(os.path.join(outdir, f"{party}.json"), "w") as f:
+        json.dump(report, f)
+    fed.shutdown()
+
+
+def test_secagg_parity_streaming_and_quorum(tmp_path_factory):
+    """Masked == unmasked BYTES on the streaming AND quorum paths, and
+    quantized-quorum == quantized-streaming (the quant= threading's
+    composition parity) — all four runs of the same recurrence land on
+    identical bytes, on every controller."""
+    outdir = str(tmp_path_factory.mktemp("secagg_parity"))
+    cluster = make_cluster(["alice", "bob"])
+    run_parties(
+        _run_secagg_parity, ["alice", "bob"], args=(cluster, outdir),
+        timeout=300,
+    )
+    reports = {}
+    for p in ("alice", "bob"):
+        with open(os.path.join(outdir, f"{p}.json")) as f:
+            reports[p] = json.load(f)
+    for p, rep in reports.items():
+        assert (
+            rep["stream_plain"] == rep["stream_secure"]
+            == rep["quorum_plain"] == rep["quorum_secure"]
+        ), f"{p}: masked/unmasked/quorum/streaming bytes diverged"
+        # Rounds 1..2 of each secure run actually masked (round 0 is
+        # the unquantized bootstrap).
+        assert rep["masked_rounds"] >= 4
+    assert reports["alice"]["stream_plain"] == reports["bob"]["stream_plain"]
+
+
+SECAGG_CHAOS_ROUNDS = 4
+
+
+def _run_secagg_chaos(party, cluster, outdir):
+    os.environ["RAYFED_SECAGG_GROUP_KEY"] = "chaos-test-key"
+    import rayfed_tpu as fed
+    from rayfed_tpu import chaos
+    from rayfed_tpu.fl import run_fedavg_rounds
+    from rayfed_tpu.fl.quorum import QUORUM_STATS
+    from rayfed_tpu.fl.secagg import SECAGG_STATS
+
+    chaos.install({
+        "seed": 7,
+        "rules": [
+            # Round 1 (the first MASKED round — round 0 bootstraps
+            # unquantized): carol straggles past the 3s deadline and
+            # dave hard-crashes — the cutoff pins {alice, bob} and the
+            # coordinator must recover BOTH dropped parties' masks.
+            {"hook": "round", "party": "carol", "match": {"round": 1},
+             "op": "delay_ms", "value": 8000},
+            {"hook": "round", "party": "dave", "match": {"round": 1},
+             "op": "crash_party"},
+            # Round 2: kill the coordinator INSIDE the mask-recovery
+            # window (after the cutoff pinned the members, before the
+            # recovery announcement) — survivors are parked on the
+            # announcement with no poison coming; only the health
+            # monitor + deterministic failover can finish the round,
+            # and the successor re-runs recovery on its own stream.
+            {"hook": "secagg_recovery", "party": "alice",
+             "match": {"round": 2}, "op": "crash_party"},
+        ],
+    })
+    params = {"w": jnp.zeros((DIM,), jnp.float32)}
+    fed.init(
+        address="local", cluster=cluster, party=party,
+        enable_waiting_for_other_parties_ready=True,
+        peer_health_interval_in_seconds=1.0, peer_death_pings=3,
+        cross_silo_timeout_in_seconds=15,
+        cross_silo_retry_policy={
+            "maxAttempts": 2, "initialBackoff": "0.2s",
+            "maxBackoff": "0.5s",
+        },
+        recv_backstop_in_seconds=120,
+    )
+    trainers = _define_trainers(fed, PARTIES)
+    log: list = []
+    try:
+        final = run_fedavg_rounds(
+            trainers, params, rounds=SECAGG_CHAOS_ROUNDS,
+            compress_wire=True, packed_wire=True, wire_dtype=jnp.float32,
+            wire_quant="uint8", secure_agg=True, quorum=2,
+            round_deadline_s=3.0, round_log=log, coordinator="alice",
+        )
+    except chaos.ChaosPartyCrash:
+        with open(os.path.join(outdir, f"{party}.json"), "w") as f:
+            json.dump({"crashed": True}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os._exit(0)
+    buf = np.asarray(final["w"], dtype=np.float32)
+    with open(os.path.join(outdir, f"{party}.json"), "w") as f:
+        json.dump({
+            "crashed": False,
+            "rounds": len(log),
+            "round1_members": sorted(
+                next(e for e in log if e["round"] == 1)["members"]
+            ),
+            "final": buf.tobytes().hex(),
+            "epoch": int(
+                fed.runtime.get_runtime().transport.roster.epoch
+            ),
+            "failovers": int(QUORUM_STATS["coordinator_failovers"]),
+            "mask_recoveries": int(SECAGG_STATS["mask_recoveries"]),
+            "recovered_seeds": int(SECAGG_STATS["recovered_seeds"]),
+            "masked_rounds": int(SECAGG_STATS["masked_rounds"]),
+        }, f)
+    fed.shutdown()
+
+
+def test_secagg_chaos_dropout_recovery_and_failover(tmp_path_factory):
+    """THE chaos e2e (N=4, quorum=2, toy model): a straggler past the
+    deadline + a hard crash in the first masked round force a quorum
+    cutoff with TWO dropped parties — the round completes only through
+    mask recovery — and a coordinator kill inside round 2's recovery
+    window reaches the PR 7 failover arm: the successor re-establishes
+    the same round (fresh mask seeds on its failover stream), re-runs
+    recovery for the dead coordinator's masks, and every survivor
+    finishes all rounds with byte-identical params."""
+    outdir = str(tmp_path_factory.mktemp("secagg_chaos"))
+    ports = get_free_ports(len(PARTIES))
+    cluster = {
+        p: {"address": f"127.0.0.1:{port}"}
+        for p, port in zip(PARTIES, ports)
+    }
+    # Fast death detection only for the parties the schedule kills — a
+    # loaded-but-healthy survivor must not be falsely declared dead.
+    for victim in ("dave", "alice"):
+        cluster[victim]["transport_options"] = {
+            "heartbeat_interval_s": 0.3, "death_deadline_s": 0.9,
+        }
+    run_parties(
+        _run_secagg_chaos, PARTIES, args=(cluster, outdir), timeout=300,
+    )
+    reports = {}
+    for p in PARTIES:
+        with open(os.path.join(outdir, f"{p}.json")) as f:
+            reports[p] = json.load(f)
+    survivors = {p: r for p, r in reports.items() if not r["crashed"]}
+    assert sorted(survivors) == ["bob", "carol"]
+    for p, r in survivors.items():
+        assert r["rounds"] == SECAGG_CHAOS_ROUNDS, (p, r)
+        # Round 1's cutoff pinned a strict subset (the straggler and
+        # the corpse excluded) — the masked round could only finalize
+        # through recovery.
+        assert r["round1_members"] == ["alice", "bob"], r
+        # Both corpses dropped from the roster, no runtime restart.
+        assert r["epoch"] >= 2, r
+        # The coordinator kill reached the failover arm everywhere.
+        assert r["failovers"] >= 1, r
+        assert r["masked_rounds"] >= 1, r
+    # Survivor byte-agreement across the recovery + failover boundary.
+    finals = {r["final"] for r in survivors.values()}
+    assert len(finals) == 1, "survivors diverged"
+    # The successor (bob) actually ran mask recovery: round 2's
+    # re-established cutoff dropped the dead coordinator, whose masks
+    # the survivors' seeds reconstructed.
+    assert survivors["bob"]["mask_recoveries"] >= 1
+    assert survivors["bob"]["recovered_seeds"] >= 1

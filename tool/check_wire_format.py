@@ -111,8 +111,26 @@ def compute_fingerprint() -> str:
     stripe_marker = wire.make_stripe_marker(sid=7, nf=4)
     # Connection HELLO handshake (wire v4): the first frame on every
     # connection; both sides parse these header keys, and the version
-    # value is what a ProtocolMismatchError names.
-    hello_header_keys = ["ver", "src"]
+    # value is what a ProtocolMismatchError names.  The secagg key
+    # advertisement (wire.SECAGG_PUB_KEY) rides the same header —
+    # optional on the wire, but its key name is contract.
+    hello_header_keys = ["ver", "src", wire.SECAGG_PUB_KEY]
+
+    # Secure aggregation (fl.secagg / transport.secagg): the HELLO
+    # advertisement format + seed-derivation semantics version, and the
+    # dropout-recovery control messages (payload-level schemas riding
+    # ordinary rendezvous sends — no frame field changes, so their
+    # drift re-pins this lock WITHOUT a wire bump, like the ring stripe
+    # manifest; SECAGG_VERSION is their version knob).
+    from rayfed_tpu.fl import secagg as fl_secagg
+    from rayfed_tpu.transport import secagg as tr_secagg
+
+    secagg_recovery_request = fl_secagg.make_recovery_request(
+        ["alice", "bob"], ["carol"]
+    )
+    secagg_recovery_reply = fl_secagg.make_recovery_reply(
+        "alice", {"carol": "00" * 32}, self_seed="11" * 32
+    )
 
     # Ring stripe manifest (the "rsm" sideband leaf of ring stripe
     # payloads, rayfed_tpu.fl.ring): a cross-party contract layered on
@@ -183,6 +201,17 @@ def compute_fingerprint() -> str:
             "quant_grid_key": wire.QUANT_GRID_KEY,
             "quant_grid_schema": _schema(quant_grid_descriptor),
             "quant_grid_version": qz.QUANT_GRID_VERSION,
+            # Secure aggregation: the HELLO key-advertisement header
+            # key, the advertisement/seed-derivation semantics version,
+            # and the recovery-message schemas (cutoff announcement +
+            # survivor seed reply) — cross-party contracts like the
+            # grid descriptor above.
+            "secagg_pub_key": wire.SECAGG_PUB_KEY,
+            "secagg_version": tr_secagg.SECAGG_VERSION,
+            "secagg_recovery_request_schema": _schema(
+                secagg_recovery_request
+            ),
+            "secagg_recovery_reply_schema": _schema(secagg_recovery_reply),
             # Frame-metadata key constants declared in wire.py (*_KEY),
             # extracted by fedlint's FED006 machinery — the same pass
             # that forbids string-literal metadata keys in transport/
