@@ -30,11 +30,14 @@ round of this framework itself (``BENCH_r*.json``), else 1.0.
 Usage: ``python bench.py`` (all configs; first run needs a few
 minutes for compiles).  ``python bench.py --fed-only`` skips the
 accelerator configs; ``--compute-only`` skips the federated ones;
-``--smoke`` runs only the streaming-aggregation, ring-aggregation and
-pipelined-overlap round benches at reduced scale (the CI gate test.sh
-drives; the ring section additionally gates
-``coord_bytes_in_frac <= 0.4`` and the overlap section
-``overlap_hidden_comm_frac >= 0.5``).
+``--smoke`` runs the streaming-aggregation, ring-aggregation (incl.
+the quantized-ring bytes probe), pipelined-overlap, send-path,
+compressed-aggregation, secure-aggregation, hierarchy traffic-vs-N
+(N∈{4,16,64} virtual parties) and chaos benches at reduced scale (the
+CI gate test.sh drives; see test.sh for the full gate list —
+``coord_bytes_in_frac <= 0.4``, ``overlap_hidden_comm_frac >= 0.5``,
+the compressed/secagg exactness gates, and the hierarchy
+flat-traffic gates).
 """
 
 from __future__ import annotations
@@ -1205,6 +1208,234 @@ def _fill_secagg_extra(extra: dict, s: dict) -> None:
     )
 
 
+def _run_hierarchy_bench(_party: str, result_q) -> None:
+    """Hierarchical aggregation traffic-vs-N: region rings + quantized
+    cross-region partial-sum streaming at N ∈ {4, 16, 64}
+    (fl.hierarchy), with N in-process VIRTUAL parties — one
+    TransportManager per party, real loopback sockets, party threads
+    driving the same ``HierarchyRound`` the fed driver ships (the
+    multi-manager shape of the secagg bench, NOT 64 subprocesses — the
+    tier-1 budget is binding).
+
+    Fixed region COUNT (2) with growing region size, so both levels'
+    fan-in stays bounded as N grows: the region ring spreads the code
+    ingress across members, the root sees (regions−1) partial-sum
+    buffers, and the broadcast fans down the tree.  Per round and per
+    N the parent gates (test.sh):
+
+    - ``hier_bitexact`` — the hierarchical aggregate is BYTE-identical
+      (on every one of the N parties) to the one-shot
+      ``packed_quantized_sum`` over all N contributions, re-coded by
+      the SAME shared quantize_downlink producer the flat streaming
+      path uses (integer folds are exact + associative: regrouping by
+      region reproduces the flat accumulator bit for bit).
+    - ``hier_party_bytes_frac_{N}`` ≤ 1.25 — mean per-party
+      bytes-on-wire within 1.25× of 2·|model| (|model| = the bf16
+      bundle bytes: one contribution out + one broadcast in is the
+      flat-traffic budget; uint8 codes and int16 partial sums are what
+      keep the tree's extra hops inside it).
+    - ``hier_ingress_flatness`` ≤ 1.6 — max-ingress-at-any-node ratio
+      between N=64 and N=4: no O(N) hub at ANY level (the flat hub's
+      coordinator ingress grows ~16× over the same range —
+      reported as ``hier_vs_hub_max_ingress_64``).
+    """
+    import socket
+    import threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.fl import fedavg as fl_fedavg
+    from rayfed_tpu.fl import quantize as qz
+    from rayfed_tpu.fl.hierarchy import HierarchyRound
+    from rayfed_tpu.transport.manager import TransportManager
+
+    def free_ports(k):
+        socks = [socket.socket() for _ in range(k)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        return ports
+
+    n_elems = 1 << 17  # 128Ki f32 elems; bf16 |model| = 256 KiB
+    ce = 1 << 11  # 64 blocks: every stripe owner owns blocks at S=32
+    model_bytes = 2 * n_elems  # bf16 bundle bytes (the |model| unit)
+    ref = np.linspace(-0.5, 0.5, n_elems, dtype=np.float32)
+    tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+    rng = np.random.default_rng(0)
+    grid = qz.make_round_grid(
+        (1e-3 * rng.standard_normal(n_elems)).astype(np.float32),
+        mode="delta", expand=4.0, chunk_elems=ce,
+    )
+
+    def contribution(i: int, r: int):
+        return fl_comp.PackedTree(
+            ref + (1e-3 * np.random.default_rng(1000 * r + i)
+                   .standard_normal(n_elems)).astype(np.float32),
+            tmpl.passthrough, tmpl.spec,
+        )
+
+    report = {"model_bytes": model_bytes}
+    for n_parties in (4, 16, 64):
+        parties = [f"h{i:02d}" for i in range(n_parties)]
+        region_size = n_parties // 2  # 2 regions at every N
+        ports = dict(zip(parties, free_ports(n_parties)))
+
+        def mk(party):
+            cc = ClusterConfig(
+                parties={
+                    p: PartyConfig.from_dict(
+                        {"address": f"127.0.0.1:{ports[p]}"}
+                    )
+                    for p in parties
+                },
+                current_party=party,
+            )
+            return TransportManager(
+                cc,
+                JobConfig(
+                    device_put_received=False,
+                    zero_copy_host_arrays=True,
+                ),
+            )
+
+        mgrs = {p: mk(p) for p in parties}
+        for m in mgrs.values():
+            m.start()
+
+        def do_round(r: int, tag: str):
+            results, errors = {}, {}
+
+            def run_party(p, i):
+                try:
+                    rnd = HierarchyRound(
+                        mgrs[p], party=p, members=parties,
+                        region_size=region_size, grid=grid,
+                        quant_ref=ref,
+                        keys=[f"{tag}{r}k{j}" for j in range(6)],
+                        stream="hb", backstop=300,
+                        quant_downlink=True,
+                    )
+                    results[p] = rnd.run(contribution(i, r))
+                except BaseException as e:  # surfaces in the parent
+                    errors[p] = e
+
+            threads = [
+                threading.Thread(
+                    target=run_party, args=(p, i), daemon=True
+                )
+                for i, p in enumerate(parties)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            if errors:
+                raise RuntimeError(
+                    f"hierarchy round failed at N={n_parties}: "
+                    f"{ {p: repr(e) for p, e in errors.items()} }"
+                )
+            return time.perf_counter() - t0, results
+
+        do_round(0, "w")  # warm: compiles + connections
+        rx0 = {
+            p: int(m.get_stats()["receive_bytes"])
+            for p, m in mgrs.items()
+        }
+        rounds = 2
+        walls = []
+        results = None
+        for r in range(1, 1 + rounds):
+            wall, results = do_round(r, "m")
+            walls.append(wall)
+        rx = {
+            p: int(mgrs[p].get_stats()["receive_bytes"]) - rx0[p]
+            for p in parties
+        }
+        for m in mgrs.values():
+            m.stop()
+
+        # Byte-exactness vs the one-shot compressed-domain reduce,
+        # re-coded by the shared downlink producer (what the flat
+        # streaming path's quant_downlink rounds return).
+        last_r = rounds
+        qts = [
+            qz.quantize_packed(contribution(i, last_r), grid, ref=ref)
+            for i in range(n_parties)
+        ]
+        exact = fl_fedavg.packed_quantized_sum(qts, ref=ref)
+        down = qz.make_round_grid(
+            np.asarray(exact.buf, np.float32) - ref,
+            chunk_elems=ce, wire_dtype=grid.wire_dtype, mode="delta",
+        )
+        expect = qz.quantize_packed(exact, down, ref=ref).dequantize(
+            np.float32, ref=ref
+        )
+        blobs = {
+            p: np.asarray(results[p].buf).tobytes() for p in parties
+        }
+        bitexact = (
+            len(set(blobs.values())) == 1
+            and blobs[parties[0]] == np.asarray(expect.buf).tobytes()
+        )
+        total_rx = sum(rx.values())
+        report[f"n{n_parties}"] = {
+            "bitexact": bool(bitexact),
+            "party_bytes": total_rx / n_parties / rounds,
+            "max_ingress": max(rx.values()) / rounds,
+            "round_s": min(walls),
+            # What the flat hub's coordinator would ingest per round
+            # over the same payloads (N-1 uint8 contributions), for
+            # the no-O(N)-hub headline.
+            "hub_max_ingress": (n_parties - 1) * n_elems,
+        }
+    result_q.put(("hierarchy", report))
+
+
+def _fill_hierarchy_extra(extra: dict, s: dict) -> None:
+    model2 = 2.0 * s["model_bytes"]  # the 2·|model| flat-traffic budget
+    bitexact = True
+    for n in (4, 16, 64):
+        sec = s[f"n{n}"]
+        bitexact = bitexact and sec["bitexact"]
+        extra[f"hier_party_bytes_frac_{n}"] = round(
+            sec["party_bytes"] / model2, 3
+        )
+        extra[f"hier_max_ingress_frac_{n}"] = round(
+            sec["max_ingress"] / model2, 3
+        )
+        extra[f"hier_round_ms_{n}"] = round(sec["round_s"] * 1e3, 1)
+    extra["hier_bitexact"] = bitexact
+    extra["hier_ingress_flatness"] = round(
+        s["n64"]["max_ingress"] / max(1.0, s["n4"]["max_ingress"]), 3
+    )
+    extra["hier_vs_hub_max_ingress_64"] = round(
+        s["n64"]["hub_max_ingress"] / max(1.0, s["n64"]["max_ingress"]),
+        2,
+    )
+    _log(
+        f"  hierarchy: per-party bytes "
+        f"{extra['hier_party_bytes_frac_4']:.2f}x / "
+        f"{extra['hier_party_bytes_frac_16']:.2f}x / "
+        f"{extra['hier_party_bytes_frac_64']:.2f}x of 2|model| at "
+        f"N=4/16/64 (budget <= 1.25x), max-node ingress "
+        f"{extra['hier_max_ingress_frac_4']:.2f}x / "
+        f"{extra['hier_max_ingress_frac_16']:.2f}x / "
+        f"{extra['hier_max_ingress_frac_64']:.2f}x "
+        f"(N=64/N=4 flatness {extra['hier_ingress_flatness']:.2f}, "
+        f"hub would be {extra['hier_vs_hub_max_ingress_64']:.1f}x "
+        f"worse at N=64); bitexact={bitexact}; round "
+        f"{extra['hier_round_ms_4']:.0f} / "
+        f"{extra['hier_round_ms_16']:.0f} / "
+        f"{extra['hier_round_ms_64']:.0f} ms"
+    )
+
+
 def _fill_compressed_extra(extra: dict, s: dict) -> None:
     extra["compressed_bytes_on_wire_frac"] = round(s["bytes_frac"], 3)
     extra["compressed_agg_GBps"] = round(s["gbps"], 3)
@@ -1558,6 +1789,48 @@ def _run_ring_agg_party(party: str, result_q) -> None:
         in0 = ingress()
         report[f"{mode}_s"] = do_rounds(mode, 1, rounds)
         report[f"{mode}_in"] = ingress() - in0
+
+    # Quantized ring (ROADMAP 2a closed: uint8 reduce-scatter AND the
+    # gather hop re-coded on the shared round grid — both halves ride
+    # integer bytes).  Cold streams each round on BOTH legs so the
+    # bytes compare codec-vs-codec: the bf16 legs above intentionally
+    # ride warm delta caches, while a quantized round's codes change
+    # nearly everywhere round-over-round — cache effects would
+    # conflate the dtype comparison.
+    from rayfed_tpu.fl import quantize as qz
+
+    q_ce = chunk_elems if chunk_elems else (1 << 21)
+    q_rng = np.random.default_rng(7)
+    q_grid = qz.make_round_grid(
+        (5e-3 * q_rng.standard_normal(n_elems)).astype(np.float32),
+        mode="delta", expand=4.0, chunk_elems=q_ce,
+    )
+
+    def do_rounds_cold(tag: str, use_quant: bool, r0: int,
+                       nrounds: int) -> float:
+        t0 = time.perf_counter()
+        for r in range(r0, r0 + nrounds):
+            objs = [
+                produce.party(p).remote(i, r)
+                for i, p in enumerate(RINGB_PARTIES)
+            ]
+            out = ring_aggregate(
+                objs, stream=f"{tag}{r}", chunk_elems=q_ce,
+                quant=q_grid if use_quant else None,
+                quant_ref=base32 if use_quant else None,
+            )
+            np.asarray(out.buf[:64])  # touch: the round really landed
+        return time.perf_counter() - t0
+
+    do_rounds_cold("rfw", False, 0, 1)  # warm compiles (f32 out path)
+    in0 = ingress()
+    report["ringf_s"] = do_rounds_cold("rfc", False, 1, rounds)
+    report["ringf_in"] = ingress() - in0
+    do_rounds_cold("rqw", True, 0, 1)  # warm the quantized kernels
+    in0 = ingress()
+    report["ringq_s"] = do_rounds_cold("rqc", True, 1, rounds)
+    report["ringq_in"] = ingress() - in0
+
     report["rounds"] = rounds
     if result_q is not None:
         result_q.put((party, report))
@@ -1590,6 +1863,22 @@ def _ring_bench_metrics(res: dict) -> dict:
         "ring_round_ms": round(ring_wall / rounds * 1e3, 1),
         "hub_round_ms": round(hub_wall / rounds * 1e3, 1),
         "ring_bundle_mb": round(bundle / 1e6, 1),
+        # Quantized ring vs bf16 ring, both on cold streams: with the
+        # reduce-scatter at uint8 AND the gather re-coded on the round
+        # grid (rsm v3), the whole round's bytes should sit near the
+        # dtype ratio (~0.5 of bf16) plus grid/manifest slack.
+        "ring_quant_bytes_frac": round(
+            sum(v["ringq_in"] for v in res.values())
+            / max(1, sum(v["ringf_in"] for v in res.values())), 3
+        ),
+        "ring_quant_round_ms": round(
+            sum(v["ringq_s"] for v in res.values()) / len(res)
+            / rounds * 1e3, 1
+        ),
+        "ring_f32cold_round_ms": round(
+            sum(v["ringf_s"] for v in res.values()) / len(res)
+            / rounds * 1e3, 1
+        ),
     }
 
 
@@ -1605,7 +1894,11 @@ def _fill_ring_extra(extra: dict, res: dict) -> None:
         f"{m['hub_round_ms']:.0f} ms "
         f"(speedup {m['ring_vs_coord_speedup']:.2f}x — loopback "
         f"under-rewards the ring; the ingress fraction is the "
-        f"topology invariant)"
+        f"topology invariant); quantized ring "
+        f"{m['ring_quant_bytes_frac']:.3f}x the bf16 ring's bytes "
+        f"(uint8 reduce-scatter + round-grid-coded gather), round "
+        f"{m['ring_quant_round_ms']:.0f} ms vs f32-cold "
+        f"{m['ring_f32cold_round_ms']:.0f} ms"
     )
 
 
@@ -3445,6 +3738,12 @@ def main() -> None:
                  "folds vs plain quantized rounds, 4 parties)...")
             sg = _one_child("_run_secagg_bench", ndev=1, timeout=420)
             _fill_secagg_extra(extra, sg)
+        with _section(extra, "hierarchy"):
+            _log("hierarchical-aggregation smoke (region rings + "
+                 "quantized cross-region streaming, traffic-vs-N at "
+                 "N=4/16/64 virtual parties)...")
+            hr = _one_child("_run_hierarchy_bench", ndev=1, timeout=420)
+            _fill_hierarchy_extra(extra, hr)
         with _section(extra, "chaos"):
             _log("chaos smoke (quorum=2 rounds under injected straggler "
                  "+ party crash + coordinator kill mid-round, 4 "
@@ -3470,6 +3769,7 @@ def main() -> None:
             or "send_path_error" in extra
             or "compressed_agg_error" in extra
             or "secagg_error" in extra
+            or "hierarchy_error" in extra
             or "chaos_error" in extra
         ):
             raise SystemExit(1)
@@ -3531,6 +3831,37 @@ def main() -> None:
                 f"secagg smoke gate FAILED: secagg_overhead_frac={sof} "
                 f"(masked rounds must cost <= 5% over plain quantized "
                 f"rounds)"
+            )
+            raise SystemExit(1)
+        # CI gates (test.sh): hierarchical aggregation must scale flat
+        # — (1) byte-identical to the one-shot compressed-domain
+        # reduce at every N (integer folds regroup exactly), (2) mean
+        # per-party bytes within 1.25x of the 2·|model| flat-traffic
+        # budget at N=4/16/64, (3) max-node-ingress ~flat in N (no
+        # O(N) hub at any level of the tree).
+        if not extra.get("hier_bitexact"):
+            _log(
+                "hierarchy smoke gate FAILED: hierarchical aggregate "
+                "!= one-shot packed_quantized_sum (+ shared downlink "
+                "recode) on some party/N"
+            )
+            raise SystemExit(1)
+        for _n in (4, 16, 64):
+            hpf = extra.get(f"hier_party_bytes_frac_{_n}")
+            if hpf is None or hpf > 1.25:
+                _log(
+                    f"hierarchy smoke gate FAILED: "
+                    f"hier_party_bytes_frac_{_n}={hpf} (per-party "
+                    f"bytes-on-wire must stay <= 1.25x of 2|model|)"
+                )
+                raise SystemExit(1)
+        hflat = extra.get("hier_ingress_flatness")
+        if hflat is None or hflat > 1.6:
+            _log(
+                f"hierarchy smoke gate FAILED: "
+                f"hier_ingress_flatness={hflat} (max-node ingress must "
+                f"stay ~flat from N=4 to N=64, ratio <= 1.6; the flat hub "
+                f"grows ~16x over the same range)"
             )
             raise SystemExit(1)
         # CI gate (test.sh): the ring must actually de-bottleneck the

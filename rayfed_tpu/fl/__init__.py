@@ -14,6 +14,11 @@ Here the common algorithms ship with the framework:
 - :mod:`secagg` — secure aggregation: pairwise-masked integer folds
   (sum-only reveal) with HELLO-handshake key agreement and
   quorum-dropout mask recovery (``run_fedavg_rounds(secure_agg=True)``).
+- :mod:`hierarchy` — many-party scale-out: deterministic region
+  partition, region-ring reduce-scatter, quantized cross-region
+  partial-sum streaming (``run_fedavg_rounds(mode="hierarchy",
+  region_size=...)``); byte-identical to the flat compressed-domain
+  fold, per-party traffic flat in N.
 - :mod:`dp` — differential privacy: global-norm clipping + Gaussian
   noise on outgoing updates.
 - :mod:`robust` — Byzantine-robust aggregation (coordinate median,
@@ -43,6 +48,11 @@ from rayfed_tpu.fl.quantize import (
     dequantize_packed,
     make_round_grid,
     quantize_packed,
+)
+from rayfed_tpu.fl.hierarchy import (
+    HierarchyRoundError,
+    RegionSumTree,
+    hierarchy_aggregate,
 )
 from rayfed_tpu.fl.overlap import PipelinedRoundRunner, dga_correct
 from rayfed_tpu.fl.quorum import (
@@ -91,6 +101,9 @@ __all__ = [
     "quantize_packed",
     "streaming_aggregate",
     "ring_aggregate",
+    "hierarchy_aggregate",
+    "HierarchyRoundError",
+    "RegionSumTree",
     "RingRoundError",
     "QuorumRoundError",
     "quorum_aggregate",

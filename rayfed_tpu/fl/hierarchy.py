@@ -1,0 +1,1047 @@
+"""Two-level hierarchical aggregation: region rings + quantized
+cross-region streaming.
+
+Every topology so far puts all N parties on ONE structure — a hub
+(``fl.streaming``), a ring (``fl.ring``) or a quorum hub (``fl.quorum``)
+— and benches at N ≤ 4.  At hundreds of silos the structure itself is
+what breaks ("Understanding Communication Backends in Cross-Silo FL",
+PAPERS.md): a hub coordinator eats O(N)·|model| ingress, and a single
+N-party ring pays N-1 serial hops of latency per stripe.  Here the
+sorted roster partitions **deterministically** into regions
+(:func:`rayfed_tpu.transport.manager.partition_regions` — every
+controller derives the same partition from the same roster epoch, no
+negotiation) and the round becomes a three-hop tree over existing
+bricks:
+
+1. **Region reduce-scatter** (``fl.ring``'s chunk-striped schedule,
+   region-scoped): each region runs the canonical chunk grid's stripe
+   schedule over its own members; integer codes
+   (:class:`~rayfed_tpu.fl.quantize.QuantGrid` — hierarchy ALWAYS runs
+   in the compressed domain, see below) flow to stripe owners and fold
+   into donated i32 accumulators
+   (:class:`~rayfed_tpu.fl.streaming.StripeAggregator`) — but, unlike a
+   flat ring round, the stripes are **not finalized**: each owner emits
+   its stripe of the region's raw integer partial sum
+   ``Σ_{p∈region} w_p·q_p``.
+
+2. **Quantized cross-region streaming**: stripe owners hand their
+   partial-sum stripes to the region coordinator (first live member of
+   the region — :func:`~rayfed_tpu.transport.manager.roster_successor`
+   semantics when the canonical first is dead), which assembles the
+   region's full partial-sum buffer (a :class:`RegionSumTree`, shipped
+   at the **narrowest exact integer width** —
+   :func:`partial_sum_dtype`: int16 whenever ``qabs_max·W`` fits, half
+   the bytes of i32) and streams it up to the ROOT coordinator, where a
+   :class:`~rayfed_tpu.fl.streaming.StreamingAggregator` in
+   ``presummed`` mode folds region sums at unit weight into the same
+   donated i32 accumulator every flat path uses.
+
+3. **Broadcast down the tree**: the root applies THE single fused
+   rescale (:func:`~rayfed_tpu.fl.fedavg.finalize_packed_quantized`)
+   once, then the aggregate travels root → region coordinators →
+   members (optionally re-quantized for the wire, the shared
+   :func:`~rayfed_tpu.fl.quantize.quantize_downlink` producer), with a
+   commit/release pass so every controller reaches the same
+   success/abort verdict (the ring's 2-pass commit, tree-shaped).
+
+**Why this is byte-identical to flat.**  Integer adds are exact and
+associative, so regrouping the fold as
+``Σ_regions (Σ_{p∈region} w_p·q_p)`` produces bit-for-bit the
+accumulator of the flat fold ``Σ_p w_p·q_p`` — and the ONE finalize is
+shared — so ``hierarchy == flat streaming == packed_quantized_sum``
+byte-identical BY CONSTRUCTION, whatever the arrival order at any
+level.  This is also why hierarchy **requires** the compressed domain:
+f32 partial sums would re-associate a non-associative fold (the same
+delta-vs-abs class of lesson PR 10 measured), so an unquantized
+hierarchy is a loud exclusion, never an approximate fallback.
+
+**Why traffic stays flat in N.**  Per ordinary member: ~|codes| out
+(reduce-scatter) + ~|codes| in + the broadcast — independent of N.  Per
+region coordinator: the region's partial-sum gather (~2·|codes| at
+int16) + one buffer up + the broadcast fan-down — independent of N for
+a fixed region COUNT, and bounded by the region size otherwise.  The
+root's ingress is (regions−1) partial-sum buffers — no node at any
+level sees O(N) ingress (gated by ``bench.py --smoke``'s
+traffic-vs-N section at N ∈ {4, 16, 64}).
+
+**Failure story.**  Any mid-round failure poisons every key the
+failing party owed (the ring's cascade, tree-shaped: errors travel up
+to the root and back down), so :class:`HierarchyRoundError` raises on
+EVERY controller and the driver falls back in lockstep —
+``run_fedavg_rounds(mode="hierarchy")`` re-aggregates the SAME round
+over the flat streaming path (classic loop) or the quorum coordinator
+path (``quorum=``), where a dead region coordinator is just a dead
+party: the quorum cutoff excludes it, the epoch announcement drops it,
+and a dead QUORUM coordinator reaches the existing
+``roster_successor`` failover arm (chaos-tested since PR 7).  The next
+round re-derives the partition from the advanced roster.  For
+mid-round re-runs with an explicitly agreed dead set,
+:func:`region_layout` also takes ``dead=``: partition stays
+roster-derived (stable), dead parties drop out of their region's
+stripe ring, and each region's coordinator moves to the
+``roster_successor``-derived next live member.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import zlib
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from rayfed_tpu.fl.compression import PackedTree, PackSpec
+from rayfed_tpu.fl.quantize import QuantizedPackedTree
+
+logger = logging.getLogger(__name__)
+
+# Version of the hierarchy region manifest ("hrm" sideband leaf) — bump
+# when make_region_meta's schema changes.  Fingerprinted (with the
+# schema) by tool/check_wire_format.py: region payloads are a
+# cross-party contract layered on the ordinary payload manifest, like
+# the ring stripe manifest.  The frame layout itself is untouched.
+HIERARCHY_VERSION = 1
+
+# Module-level round counters (the trainer's fallback path and tests
+# read these — mirrors fl.ring.RING_STATS).
+HIER_STATS: Dict[str, int] = {
+    "rounds_completed": 0,
+    "rounds_aborted": 0,
+    "fallback_rounds": 0,
+}
+
+# Test-only fault injection: when set, called with (phase, party) at
+# each step of the member flow ("local", "rs", "ps", "up", "down",
+# "commit").  Raising simulates a failure at exactly that phase; the
+# in-process chaos tests also hard-stop a virtual party's transport
+# from here.  Takes the party because in-process virtual parties share
+# one process (unlike fl.ring's per-process hook).
+_fault_hook: Optional[Callable[[str, str], None]] = None
+
+
+def _maybe_fault(phase: str, party: str) -> None:
+    if _fault_hook is not None:
+        _fault_hook(phase, party)
+
+
+# Seq ids one hierarchy_aggregate call consumes — callers pre-allocating
+# ids (the quorum driver derives string keys instead) pass exactly this
+# many, in next_seq_id order: (rs, ps, up, down, commit, release).
+HIER_SEQ_IDS = 6
+
+
+class HierarchyRoundError(RuntimeError):
+    """A hierarchy round aborted (peer death, wire failure, poisoned
+    hop, partition disagreement).  The round's contributions are still
+    intact on their owners — re-aggregate the SAME round over the flat
+    streaming/quorum topology (``run_fedavg_rounds(mode="hierarchy")``
+    does exactly that)."""
+
+
+def members_fingerprint(members: Sequence[str]) -> int:
+    """CRC32 over the sorted roster — what region manifests carry so
+    two controllers that derived DIFFERENT partitions (a missed epoch
+    advance) abort loudly instead of folding mismatched stripes."""
+    return zlib.crc32("\n".join(sorted(members)).encode())
+
+
+def partial_sum_dtype(qabs_max: int, total_weight: int) -> str:
+    """Narrowest integer wire dtype that holds ``qabs_max · W`` exactly.
+
+    A region partial sum ``Σ w_p·q_p`` is bounded by the ROSTER total's
+    headroom bound, so int16 (half the i32 bytes) carries it exactly
+    whenever ``qabs_max·W ≤ 2¹⁵−1`` — e.g. unit weights up to 128
+    parties at uint8.  Every controller derives the same dtype from the
+    same shared weights; the receiver's fold widens to i32 regardless.
+    """
+    bound = int(qabs_max) * int(total_weight)
+    if bound <= 2**15 - 1:
+        return "int16"
+    if bound <= 2**31 - 1:
+        return "int32"
+    raise ValueError(
+        f"integer-fold overflow: qabs_max {qabs_max} x total weight "
+        f"{total_weight} = {bound} exceeds the i32 accumulator bound — "
+        f"rescale the example counts"
+    )
+
+
+class HierarchyLayout(NamedTuple):
+    """One round's derived two-level topology (identical on every
+    controller: pure function of (sorted members, region_size, dead))."""
+
+    regions: List[List[str]]      # full partition of the roster
+    live: List[List[str]]         # per-region live members (sorted)
+    coordinators: Dict[int, str]  # region index -> live coordinator
+    active: List[int]             # region indices with >= 1 live member
+    root: str                     # the root coordinator
+    root_region: int
+
+
+def region_layout(
+    members: Sequence[str], region_size: int, dead: Sequence[str] = ()
+) -> HierarchyLayout:
+    """Derive the round's region topology.
+
+    The PARTITION derives from the roster alone (stable under a
+    mid-round death — re-partitioning on health signals would move
+    every stripe).  ``dead`` parties drop out of their region's stripe
+    ring and fold set; a dead canonical coordinator's region fails over
+    to the :func:`~rayfed_tpu.transport.manager.roster_successor`-
+    derived next live member.  The root is the first active region's
+    coordinator.
+    """
+    from rayfed_tpu.transport.manager import partition_regions, roster_successor
+
+    regions = partition_regions(members, region_size)
+    dead_set = set(dead)
+    live = [[p for p in r if p not in dead_set] for r in regions]
+    coordinators: Dict[int, str] = {}
+    active: List[int] = []
+    for g, r in enumerate(regions):
+        if not live[g]:
+            continue
+        if r[0] in dead_set:
+            succ = roster_successor(r, r[0], dead_set)
+            if succ is None:  # pragma: no cover - live[g] non-empty
+                continue
+            coordinators[g] = succ
+        else:
+            coordinators[g] = r[0]
+        active.append(g)
+    if not active:
+        raise HierarchyRoundError(
+            f"no live party remains on the roster {sorted(members)} "
+            f"(dead: {sorted(dead_set)})"
+        )
+    root_region = active[0]
+    return HierarchyLayout(
+        regions, live, coordinators, active,
+        coordinators[root_region], root_region,
+    )
+
+
+def make_region_meta(
+    phase: str,
+    region: int,
+    n_regions: int,
+    stripe: int,
+    n_stripes: int,
+    nblocks: int,
+    total_elems: int,
+    dtype: str,
+    qgrid_fp: int,
+    members_fp: int,
+    epoch: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The ``hrm`` sideband of a hierarchy payload — single producer of
+    its schema (``tool/check_wire_format.py`` fingerprints it).
+
+    ``phase`` is ``"rs"`` (region reduce-scatter codes) or ``"ps"`` (a
+    stripe of the region's integer partial sum).  Receivers cross-check
+    every field against their independently derived layout, so a
+    partition disagreement (``mf``: the roster fingerprint), a stale
+    epoch (``ep``) or a grid mismatch (``qg``) fails loudly BEFORE any
+    block folds.
+    """
+    return {
+        "v": HIERARCHY_VERSION,
+        "ph": str(phase),
+        "rg": int(region),
+        "nr": int(n_regions),
+        "s": int(stripe),
+        "n": int(n_stripes),
+        "nb": int(nblocks),
+        "el": int(total_elems),
+        "dt": str(dtype),
+        "qg": int(qgrid_fp),
+        "mf": int(members_fp),
+        "ep": -1 if epoch is None else int(epoch),
+    }
+
+
+def check_region_meta(meta_json: str, want: Dict[str, Any]) -> None:
+    """Validate a received ``hrm`` manifest against the locally derived
+    layout; raises naming the first mismatched field."""
+    hrm = json.loads(meta_json)
+    if hrm.get("v", 0) > HIERARCHY_VERSION:
+        raise HierarchyRoundError(
+            f"region payload uses hierarchy manifest v{hrm.get('v')}; "
+            f"this party understands up to v{HIERARCHY_VERSION}"
+        )
+    for key, expect in want.items():
+        if hrm.get(key) != expect:
+            raise HierarchyRoundError(
+                f"region manifest mismatch: {key}={hrm.get(key)!r}, "
+                f"expected {expect!r} — hierarchy peers disagree on the "
+                f"round's partition/grid/epoch"
+            )
+
+
+class RegionSumTree(QuantizedPackedTree):
+    """Wire form of a region's integer partial sum: ``Σ_{p∈region}
+    w_p·q_p`` on the round's shared grid, at the narrowest exact
+    integer width (:func:`partial_sum_dtype`), with the grid descriptor
+    riding along (the root still verifies the fingerprint before
+    folding).
+
+    Deliberately NOT decodable on its own: a partial sum is meaningless
+    before the root's single fused rescale over the WHOLE roster's
+    weight — :meth:`dequantize`/:meth:`unpack` raise instead of
+    silently rescaling a subtree's sum as if it were the round's.  Fold
+    with a ``presummed`` :class:`~rayfed_tpu.fl.streaming.
+    StreamingAggregator`, whose unit-weight integer fold reassembles
+    exactly the flat accumulator.
+    """
+
+    __slots__ = ()
+
+    def dequantize(self, out_dtype: Any = np.float32,
+                   ref: Optional[Any] = None):
+        raise HierarchyRoundError(
+            "a RegionSumTree is an integer PARTIAL sum — only the root "
+            "fold (StreamingAggregator(presummed=...)) may rescale it, "
+            "once, over the whole roster's weight"
+        )
+
+    def unpack(self, dtype: Any = None):
+        raise HierarchyRoundError(
+            "a RegionSumTree cannot be unpacked — see dequantize"
+        )
+
+    def __reduce__(self):
+        return (
+            RegionSumTree,
+            (self.buf, self.scales, self.zps, self.passthrough,
+             self.spec, self.gmeta),
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"RegionSumTree({self.gmeta.total_elems} partial-sum "
+            f"elements on grid fp={self.gmeta.fp:#010x})"
+        )
+
+
+import jax  # noqa: E402  (after the numpy-only machinery, like quantize)
+
+jax.tree_util.register_pytree_node(
+    RegionSumTree,
+    lambda rt: (
+        (rt.buf, rt.scales, rt.zps, *rt.passthrough),
+        (rt.spec, rt.gmeta),
+    ),
+    lambda aux, ch: RegionSumTree(
+        ch[0], ch[1], ch[2], tuple(ch[3:]), aux[0], aux[1]
+    ),
+)
+
+
+from rayfed_tpu.fl.streaming import StripeAggregator  # noqa: E402
+
+
+class _RawStripeAggregator(StripeAggregator):
+    """A region stripe owner's fold that emits the RAW i32 partial sum
+    instead of a finalized stripe — the region level must NOT rescale
+    (the single fused divide belongs to the root; a per-region divide
+    would round twice and break hierarchical == flat byte-identity)."""
+
+    def _finalize(self):
+        # The donated accumulator holds Σ w_p·widen(q_p) on the padded
+        # block grid; trim the pad, keep the exact integers.
+        import jax
+
+        acc = self._acc
+        jax.block_until_ready(acc)
+        return np.asarray(acc)[: self._total_elems]
+
+
+# Stripe geometry (compaction + short-tail arithmetic) is the SAME
+# cross-party contract the flat ring uses — one definition, not a copy
+# that could silently diverge.
+from rayfed_tpu.fl.ring import _stripe_elems, _stripe_slice  # noqa: E402
+
+
+class HierarchyRound:
+    """One party's data-plane walk of a hierarchical round.
+
+    Deliberately driven through a :class:`~rayfed_tpu.transport.manager.
+    TransportManager`-shaped object (``send``/``send_many``/``recv``/
+    ``recv_stream_many``/``cancel_stream``) rather than the fed runtime:
+    the fed wrapper (:func:`hierarchy_aggregate`), the traffic bench
+    (``bench.py``'s N∈{4,16,64} virtual parties) and the in-process
+    chaos tests all drive EXACTLY this class, so what the bench gates is
+    what the driver ships.
+
+    ``keys`` are the round's six rendezvous ids ``(rs, ps, up, down,
+    commit, release)`` — every controller passes identical ones.
+    ``epoch`` stamps every frame (``wire.EPOCH_TAG_KEY``): a receiver
+    whose roster advanced rejects stale-region frames loudly.
+    """
+
+    def __init__(
+        self,
+        transport: Any,
+        *,
+        party: str,
+        members: Sequence[str],
+        region_size: int,
+        grid: Any,
+        quant_ref: Optional[Any],
+        keys: Sequence[Any],
+        weights: Optional[Dict[str, float]] = None,
+        stream: str = "hier",
+        epoch: Optional[int] = None,
+        round_tag: Optional[int] = None,
+        backstop: Optional[float] = None,
+        quant_scope: Optional[str] = None,
+        allowed: Optional[Dict[str, Any]] = None,
+        quant_downlink: bool = False,
+        dead: Sequence[str] = (),
+        timings: Optional[Dict[str, float]] = None,
+    ) -> None:
+        from rayfed_tpu.fl.fedavg import quant_weights
+        from rayfed_tpu.fl.quantize import RoundCodec
+
+        if grid is None:
+            raise HierarchyRoundError(
+                "hierarchical aggregation runs in the compressed domain "
+                "ONLY: float partial sums would re-associate a "
+                "non-associative fold and silently break hierarchical "
+                "== flat byte-identity — pass the round's shared "
+                "QuantGrid (wire_quant)"
+            )
+        if len(keys) != HIER_SEQ_IDS:
+            raise ValueError(
+                f"hierarchy rounds consume {HIER_SEQ_IDS} rendezvous "
+                f"ids, got {len(keys)}"
+            )
+        self._t = transport
+        self._me = str(party)
+        self._members = sorted(members)
+        if self._me not in self._members:
+            raise HierarchyRoundError(
+                f"{self._me!r} is not on the round roster "
+                f"{self._members} — observer controllers are not "
+                f"supported by hierarchy rounds"
+            )
+        self._dead = set(dead)
+        if self._me in self._dead:
+            raise HierarchyRoundError(
+                f"{self._me!r} is in the round's agreed dead set"
+            )
+        self._lay = region_layout(self._members, region_size, self._dead)
+        self._grid = grid
+        self._codec = RoundCodec(grid, quant_ref, quant_scope)
+        self._qref = self._codec.ref
+        self._keys = tuple(keys)
+        self._stream = stream
+        self._epoch = epoch
+        self._round_tag = round_tag
+        self._backstop = backstop
+        self._allowed = allowed
+        self._quant_scope = quant_scope
+        self._quant_downlink = bool(quant_downlink)
+        self._timings = timings
+        contributors = [p for p in self._members if p not in self._dead]
+        w_list = (
+            None if weights is None
+            else [float(weights[p]) for p in contributors]
+        )
+        iw, itotal = quant_weights(w_list, len(contributors))
+        self._iw = dict(zip(contributors, iw))
+        self._w_total = itotal
+        grid.check_weight_headroom(itotal)
+        self._ps_dtype = partial_sum_dtype(grid.qabs_max, itotal)
+        self._members_fp = members_fingerprint(self._members)
+        self._pending_cancels: List[tuple] = []
+
+    # -- helpers --------------------------------------------------------------
+
+    def _send(self, dest: str, value: Any, up: str, *, down: Any,
+              stream: Optional[str] = None, quant_meta=None):
+        return self._t.send(
+            dest, value, up, down, stream=stream,
+            round_tag=self._round_tag, epoch_tag=self._epoch,
+            quant_meta=quant_meta,
+        )
+
+    def _recv(self, src: str, up: str, down: Any):
+        return self._t.recv(src, up, down)
+
+    def _region_totals(self) -> Dict[int, int]:
+        return {
+            g: sum(self._iw[p] for p in self._lay.live[g])
+            for g in self._lay.active
+        }
+
+    def _hrm(self, phase: str, g: int, stripe: int, n_stripes: int,
+             nblocks: int, dtype: str) -> str:
+        return json.dumps(
+            make_region_meta(
+                phase, g, len(self._lay.regions), stripe, n_stripes,
+                nblocks, self._grid.total_elems, dtype,
+                self._grid.fingerprint(), self._members_fp,
+                epoch=self._epoch,
+            ),
+            sort_keys=True,
+        )
+
+    def _hrm_want(self, phase: str, g: int, stripe: int, n_stripes: int,
+                  nblocks: int, dtype: str) -> Dict[str, Any]:
+        return {
+            "ph": phase, "rg": g, "nr": len(self._lay.regions),
+            "s": stripe, "n": n_stripes, "nb": nblocks,
+            "el": self._grid.total_elems, "dt": dtype,
+            "qg": self._grid.fingerprint(), "mf": self._members_fp,
+            "ep": -1 if self._epoch is None else int(self._epoch),
+        }
+
+    # -- the round ------------------------------------------------------------
+
+    def run(self, local_value: Any) -> PackedTree:
+        """Walk the round; returns the finalized aggregate (identical
+        bytes on every controller) or raises
+        :class:`HierarchyRoundError` on every controller."""
+        t0 = time.perf_counter()
+        try:
+            result = self._run_inner(local_value)
+        except BaseException as exc:
+            self._codec.rollback()
+            for up, down in self._pending_cancels:
+                try:
+                    self._t.cancel_stream(up, down)
+                except Exception:  # pragma: no cover - best effort
+                    pass
+            self._poison_edges(exc)
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                # The poison still unparks the peers, but an interrupt
+                # must STOP the caller unwrapped (the fl.ring contract).
+                raise
+            HIER_STATS["rounds_aborted"] += 1
+            if isinstance(exc, HierarchyRoundError):
+                raise
+            raise HierarchyRoundError(
+                f"hierarchy round aborted: {exc!r}"
+            ) from exc
+        self._codec.commit()
+        HIER_STATS["rounds_completed"] += 1
+        if self._timings is not None:
+            self._timings["agg_s"] = time.perf_counter() - t0
+            self._timings.setdefault("push_s", 0.0)
+        return result
+
+    def _run_inner(self, local_value: Any) -> PackedTree:
+        from rayfed_tpu.fl.fedavg import packed_block_grid
+        from rayfed_tpu.fl.fedavg import packed_stripe_schedule
+        from rayfed_tpu.fl.streaming import StreamingAggregator
+        from rayfed_tpu.fl import quantize as qz
+
+        me = self._me
+        lay = self._lay
+        rs_id, ps_id, up_id, down_id, commit_id, release_id = self._keys
+        backstop = self._backstop
+        t_call0 = time.perf_counter()
+
+        _maybe_fault("local", me)
+        q = self._codec.to_wire(local_value)
+        if q.passthrough:
+            raise HierarchyRoundError(
+                f"hierarchical aggregation covers the packed float "
+                f"buffer only, but this update carries "
+                f"{len(q.passthrough)} non-float (passthrough) leaf(s) "
+                f"— their per-leaf reduce has no tree decomposition "
+                f"yet; drop them from the update tree (loud exclusion, "
+                f"never a silent partial aggregate)"
+            )
+        buf = np.asarray(q.buf).reshape(-1)
+        g = next(
+            j for j in lay.active if me in lay.live[j]
+        )
+        region = lay.live[g]
+        m = region.index(me)
+        coord = lay.coordinators[g]
+        is_coord = me == coord
+        is_root = me == lay.root
+        ce = self._grid.chunk_elems
+        total_elems = self._grid.total_elems
+        nblocks = packed_block_grid(total_elems, ce)
+        s_n = len(region)
+        stripes = packed_stripe_schedule(nblocks, s_n)
+        wire_name = self._grid.wire_dtype
+
+        def elems(k: int) -> int:
+            return _stripe_elems(stripes[k], ce, nblocks, total_elems)
+
+        # -- 1. region reduce-scatter (codes -> stripe owners) ---------
+        agg = None
+        my_se = elems(m)
+        if my_se:
+            want = self._hrm_want("rs", g, m, s_n, nblocks, wire_name)
+            agg = _RawStripeAggregator(
+                s_n,
+                weights=[float(self._iw[p]) for p in region],
+                allowed=self._allowed,
+                chunk_elems=ce,
+                expect_elems=my_se,
+                label=f"region {g} stripe {m}",
+                meta_check=lambda v: check_region_meta(v, want),
+                quant=self._grid,
+                quant_blocks=stripes[m],
+                quant_ref=(
+                    None if self._qref is None else _stripe_slice(
+                        self._qref, stripes[m], ce, total_elems
+                    )
+                ),
+            )
+            entries = []
+            for i, p in enumerate(region):
+                if i == m:
+                    continue
+                entries.append(
+                    (p, f"{rs_id}.{g}.{i}.{m}", rs_id, agg.sink(i))
+                )
+                self._pending_cancels.append(
+                    (f"{rs_id}.{g}.{i}.{m}", rs_id)
+                )
+            if entries:
+                self._t.recv_stream_many(entries)
+
+        _maybe_fault("rs", me)
+        rs_refs = []
+        for k, p in enumerate(region):
+            if k == m or not elems(k):
+                continue
+            payload = {
+                "data": _stripe_slice(buf, stripes[k], ce, total_elems),
+                "hrm": self._hrm("rs", g, k, s_n, nblocks, wire_name),
+            }
+            rs_refs.append((p, f"{rs_id}.{g}.{m}.{k}", self._send(
+                p, payload, f"{rs_id}.{g}.{m}.{k}", down=rs_id,
+                stream=f"{self._stream}/rs",
+                quant_meta=self._codec.descriptor,
+            )))
+        if agg is not None:
+            agg.add_local(
+                m, _stripe_slice(buf, stripes[m], ce, total_elems)
+            )
+        for p, up, ref in rs_refs:
+            if not ref.resolve(timeout=backstop):
+                raise HierarchyRoundError(
+                    f"region reduce-scatter push {up!r} to {p!r} failed"
+                )
+        if self._timings is not None:
+            self._timings["push_s"] = time.perf_counter() - t_call0
+
+        raw_stripe = None
+        if agg is not None:
+            raw = agg.result(timeout=backstop)  # exact i32 partial sums
+            # Narrowest exact width for the wire: bounded by
+            # qabs_max * W_total by construction, so the cast is exact.
+            raw_stripe = raw.astype(np.dtype(self._ps_dtype))
+
+        # -- 2. partial-sum gather to the region coordinator -----------
+        _maybe_fault("ps", me)
+        if not is_coord:
+            if raw_stripe is not None:
+                ref = self._send(
+                    coord,
+                    {
+                        "data": raw_stripe,
+                        "hrm": self._hrm(
+                            "ps", g, m, s_n, nblocks, self._ps_dtype
+                        ),
+                    },
+                    f"{ps_id}.{g}.{m}", down=ps_id,
+                    quant_meta=self._codec.descriptor,
+                )
+                if not ref.resolve(timeout=backstop):
+                    raise HierarchyRoundError(
+                        f"partial-sum stripe {m} of region {g} to "
+                        f"coordinator {coord!r} failed"
+                    )
+        else:
+            ps_full = np.zeros(total_elems, np.dtype(self._ps_dtype))
+
+            def scatter(stripe_arr: np.ndarray, blocks) -> None:
+                off = 0
+                for b in blocks:
+                    size = min(ce, total_elems - b * ce)
+                    ps_full[b * ce : b * ce + size] = (
+                        stripe_arr[off : off + size]
+                    )
+                    off += size
+
+            if raw_stripe is not None:
+                scatter(raw_stripe, stripes[m])
+            ps_refs = {}
+            for k, p in enumerate(region):
+                if k == m or not elems(k):
+                    continue
+                ps_refs[k] = (p, self._recv(p, f"{ps_id}.{g}.{k}", ps_id))
+            for k, (p, ref) in ps_refs.items():
+                value = ref.resolve(timeout=backstop)
+                check_region_meta(
+                    value["hrm"],
+                    self._hrm_want(
+                        "ps", g, k, s_n, nblocks, self._ps_dtype
+                    ),
+                )
+                arr = np.asarray(value["data"]).reshape(-1)
+                if arr.size != elems(k):
+                    raise HierarchyRoundError(
+                        f"partial-sum stripe {k} of region {g} carries "
+                        f"{arr.size} elements, schedule says {elems(k)}"
+                    )
+                scatter(arr, stripes[k])
+
+        # -- 3. region sums stream to the root --------------------------
+        _maybe_fault("up", me)
+        result = None
+        totals = self._region_totals()
+        if is_coord:
+            spec = PackSpec(
+                q.spec.entries, q.spec.treedef, self._ps_dtype
+            )
+            region_sum = RegionSumTree(
+                ps_full, self._grid.scales, self._grid.zps, (), spec,
+                self._grid.meta(),
+            )
+            if not is_root:
+                ref = self._send(
+                    lay.root, region_sum, f"{up_id}.{g}", down=up_id,
+                    stream=f"{self._stream}/up/{g}",
+                    quant_meta=self._codec.descriptor,
+                )
+                if not ref.resolve(timeout=backstop):
+                    raise HierarchyRoundError(
+                        f"region {g} partial sum to root "
+                        f"{lay.root!r} failed"
+                    )
+            else:
+                root_agg = StreamingAggregator(
+                    len(lay.active),
+                    weights=[float(totals[j]) for j in lay.active],
+                    allowed=self._allowed,
+                    chunk_elems=ce,
+                    quant=self._grid,
+                    quant_ref=self._qref,
+                    presummed=self._ps_dtype,
+                    labels=[f"region {j}" for j in lay.active],
+                )
+                entries = []
+                for idx, j in enumerate(lay.active):
+                    if j == g:
+                        continue
+                    entries.append((
+                        lay.coordinators[j], f"{up_id}.{j}", up_id,
+                        root_agg.sink(idx),
+                    ))
+                    self._pending_cancels.append((f"{up_id}.{j}", up_id))
+                if entries:
+                    self._t.recv_stream_many(entries)
+                root_agg.add_local(lay.active.index(g), region_sum)
+                result = root_agg.result(timeout=backstop)
+
+        # -- 4. broadcast down the tree ---------------------------------
+        _maybe_fault("down", me)
+        down_descr = None
+        if is_root:
+            wire_result = result
+            if self._quant_downlink:
+                wire_result, result, down_descr = qz.quantize_downlink(
+                    result, self._grid, self._qref, self._quant_scope,
+                )
+            coord_dests = [
+                lay.coordinators[j] for j in lay.active
+                if j != lay.root_region
+            ]
+            down_refs = []
+            if coord_dests:
+                down_refs.extend(self._t.send_many(
+                    coord_dests, wire_result, f"{down_id}.c", down_id,
+                    stream=f"{self._stream}/down",
+                    round_tag=self._round_tag, epoch_tag=self._epoch,
+                    quant_meta=down_descr,
+                ).items())
+            my_members = [p for p in region if p != me]
+            if my_members:
+                down_refs.extend(self._t.send_many(
+                    my_members, wire_result, f"{down_id}.m", down_id,
+                    stream=f"{self._stream}/down",
+                    round_tag=self._round_tag, epoch_tag=self._epoch,
+                    quant_meta=down_descr,
+                ).items())
+            for p, ref in down_refs:
+                if not ref.resolve(timeout=backstop):
+                    raise HierarchyRoundError(
+                        f"result broadcast to {p!r} failed"
+                    )
+        elif is_coord:
+            value = self._recv(
+                lay.root, f"{down_id}.c", down_id
+            ).resolve(timeout=backstop)
+            result = self._decode_down(value)
+            fwd_meta = None
+            if isinstance(value, QuantizedPackedTree):
+                fwd_meta = qz.grid_descriptor(value.grid())
+            my_members = [p for p in region if p != me]
+            if my_members:
+                refs = self._t.send_many(
+                    my_members, value, f"{down_id}.m", down_id,
+                    stream=f"{self._stream}/down",
+                    round_tag=self._round_tag, epoch_tag=self._epoch,
+                    quant_meta=fwd_meta,
+                )
+                for p, ref in refs.items():
+                    if not ref.resolve(timeout=backstop):
+                        raise HierarchyRoundError(
+                            f"result forward to member {p!r} failed"
+                        )
+        else:
+            value = self._recv(
+                coord, f"{down_id}.m", down_id
+            ).resolve(timeout=backstop)
+            result = self._decode_down(value)
+
+        # -- 5. commit/release: agree the round landed everywhere -------
+        # Tree-shaped two-phase commit (fl.ring's token ring, one level
+        # up): coordinators confirm their region's broadcast ACKed, the
+        # root collects every region's commit, and a release travels
+        # back down — a member only RETURNS once released, so success/
+        # abort is a lockstep verdict.  Like any atomic commit, a crash
+        # inside the tiny release pass itself can strand waiters until
+        # the backstop; the bulk phases are fully covered.
+        _maybe_fault("commit", me)
+        token = {"ok": 1}
+        if is_root:
+            for j in lay.active:
+                if j == lay.root_region:
+                    continue
+                self._recv(
+                    lay.coordinators[j], f"{commit_id}.{j}", commit_id
+                ).resolve(timeout=backstop)
+            rel_dests = [
+                lay.coordinators[j] for j in lay.active
+                if j != lay.root_region
+            ] + [p for p in region if p != me]
+            if rel_dests:
+                refs = self._t.send_many(
+                    rel_dests, token, f"{release_id}.r", release_id,
+                    round_tag=self._round_tag, epoch_tag=self._epoch,
+                )
+                for p, ref in refs.items():
+                    if not ref.resolve(timeout=backstop):
+                        # Post-commit best effort: the stranded waiter
+                        # aborts at its backstop (residual window).
+                        logger.warning(
+                            "[%s] release token to %s failed", me, p,
+                        )
+        elif is_coord:
+            ref = self._send(
+                lay.root, token, f"{commit_id}.{g}", down=commit_id
+            )
+            if not ref.resolve(timeout=backstop):
+                raise HierarchyRoundError(
+                    f"commit token of region {g} to root "
+                    f"{lay.root!r} failed"
+                )
+            self._recv(
+                lay.root, f"{release_id}.r", release_id
+            ).resolve(timeout=backstop)
+            my_members = [p for p in region if p != me]
+            if my_members:
+                refs = self._t.send_many(
+                    my_members, token, f"{release_id}.r", release_id,
+                    round_tag=self._round_tag, epoch_tag=self._epoch,
+                )
+                for p, ref in refs.items():
+                    if not ref.resolve(timeout=backstop):
+                        logger.warning(  # pragma: no cover
+                            "[%s] release token to %s failed", me, p,
+                        )
+        else:
+            self._recv(
+                coord, f"{release_id}.r", release_id
+            ).resolve(timeout=backstop)
+        return result
+
+    def _decode_down(self, value: Any) -> PackedTree:
+        if isinstance(value, RegionSumTree):
+            raise HierarchyRoundError(
+                "broadcast carried a RegionSumTree — the downlink must "
+                "be the FINALIZED aggregate"
+            )
+        if isinstance(value, QuantizedPackedTree):
+            return value.dequantize(
+                np.float32,
+                ref=self._qref if value.gmeta.mode == "delta" else None,
+            )
+        if not isinstance(value, PackedTree):
+            raise HierarchyRoundError(
+                f"broadcast carried {type(value).__name__}, expected "
+                f"the aggregated PackedTree"
+            )
+        return value
+
+    def _poison_edges(self, exc: BaseException) -> None:
+        """Best-effort poison of every rendezvous key this party
+        produces, so peers parked on them raise within a round trip
+        (the fl.ring cascade, tree-shaped: the abort travels up to the
+        root and back down every branch)."""
+        poison = getattr(self._t, "_send_poison", None)
+        if poison is None:
+            return
+        lay = self._lay
+        me = self._me
+        rs_id, ps_id, up_id, down_id, commit_id, release_id = self._keys
+        g = next(
+            (j for j in lay.active if me in lay.live[j]), None
+        )
+        if g is None:  # pragma: no cover - run() rejects dead callers
+            return
+        region = lay.live[g]
+        m = region.index(me)
+        coord = lay.coordinators[g]
+        edges: List[tuple] = []
+        for k, p in enumerate(region):
+            if k != m:
+                edges.append((p, f"{rs_id}.{g}.{m}.{k}", rs_id))
+        if me != coord:
+            edges.append((coord, f"{ps_id}.{g}.{m}", ps_id))
+        else:
+            if me != lay.root:
+                edges.append((lay.root, f"{up_id}.{g}", up_id))
+                edges.append((lay.root, f"{commit_id}.{g}", commit_id))
+            else:
+                for j in lay.active:
+                    if j == lay.root_region:
+                        continue
+                    edges.append(
+                        (lay.coordinators[j], f"{down_id}.c", down_id)
+                    )
+                    edges.append(
+                        (lay.coordinators[j], f"{release_id}.r",
+                         release_id)
+                    )
+            for p in region:
+                if p != me:
+                    edges.append((p, f"{down_id}.m", down_id))
+                    edges.append((p, f"{release_id}.r", release_id))
+        for dest, up, down in edges:
+            if dest == me:
+                continue
+            try:
+                poison(dest, up, down, exc)
+            except Exception:  # pragma: no cover - best effort
+                logger.exception(
+                    "[%s] failed to poison hierarchy edge (%s, %s) at "
+                    "%s", me, up, down, dest,
+                )
+
+
+def hierarchy_aggregate(
+    fed_objects: Sequence[Any],
+    weights: Optional[Sequence[float]] = None,
+    *,
+    region_size: int,
+    stream: str = "hier",
+    timeout: Optional[float] = None,
+    quant: Any = None,
+    quant_ref: Optional[Any] = None,
+    quant_scope: Optional[str] = None,
+    quant_downlink: bool = False,
+    seq_ids: Optional[Sequence[Any]] = None,
+    round_tag: Optional[int] = None,
+    epoch: Optional[int] = None,
+    timings: Optional[Dict[str, float]] = None,
+    dead: Sequence[str] = (),
+) -> Any:
+    """FedAvg round over the two-level hierarchy (see module docstring).
+
+    Drop-in for ``streaming_aggregate``/``ring_aggregate`` when the
+    contributions are PackedTrees with one contribution per party and
+    the round runs in the compressed domain (``quant`` is REQUIRED —
+    hierarchical float sums are a loud exclusion): every controller
+    calls it at the same program point with the same arguments and
+    returns the identical aggregate bytes — byte-identical to
+    :func:`~rayfed_tpu.fl.fedavg.packed_quantized_sum` over the same
+    contributions, and therefore to the flat quantized streaming path.
+
+    ``region_size`` partitions the sorted roster deterministically
+    (:func:`~rayfed_tpu.transport.manager.partition_regions`).
+    ``seq_ids``: :data:`HIER_SEQ_IDS` pre-allocated rendezvous ids (the
+    quorum driver passes round-derived string keys).  ``epoch`` stamps
+    every frame (stale-region frames are rejected loudly).  Aborted
+    rounds raise :class:`HierarchyRoundError` on EVERY controller so
+    the driver can fall back in lockstep.  Multi-host parties: leader
+    processes only (like ``streaming_aggregate``).
+    """
+    from rayfed_tpu.fed_object import FedObject
+    from rayfed_tpu.runtime import get_runtime
+
+    runtime = get_runtime()
+    objs = list(fed_objects)
+    if not objs:
+        raise ValueError(
+            "hierarchy_aggregate needs at least one contribution"
+        )
+    for obj in objs:
+        if not isinstance(obj, FedObject):
+            raise TypeError(
+                "hierarchy_aggregate consumes FedObjects (party-owned "
+                f"contributions), got {type(obj).__name__}"
+            )
+    owners = [obj.get_party() for obj in objs]
+    if len(set(owners)) != len(owners):
+        raise ValueError(
+            "hierarchy_aggregate needs exactly one contribution per "
+            f"party (owners: {owners}) — aggregate duplicates locally "
+            f"first"
+        )
+    if weights is not None and len(weights) != len(objs):
+        raise ValueError(
+            f"{len(weights)} weights for {len(objs)} contributions"
+        )
+    if seq_ids is None:
+        seq_ids = [runtime.next_seq_id() for _ in range(HIER_SEQ_IDS)]
+    me = runtime.party
+    backstop = (
+        timeout if timeout is not None
+        else runtime.job_config.recv_backstop_s
+    )
+    w_map = (
+        None if weights is None
+        else {p: float(w) for p, w in zip(owners, weights)}
+    )
+    if me not in owners:
+        raise HierarchyRoundError(
+            f"{me!r} contributes nothing this round — observer "
+            f"controllers are not supported by hierarchy rounds (use "
+            f"the flat streaming path)"
+        )
+    rnd = HierarchyRound(
+        runtime.send_proxy,
+        party=me,
+        members=owners,
+        region_size=region_size,
+        grid=quant,
+        quant_ref=quant_ref,
+        keys=seq_ids,
+        weights=w_map,
+        stream=stream,
+        epoch=epoch,
+        round_tag=round_tag,
+        backstop=backstop,
+        quant_scope=quant_scope,
+        allowed=runtime.cluster_config.serializing_allowed_list,
+        quant_downlink=quant_downlink,
+        dead=dead,
+        timings=timings,
+    )
+    local_value = (
+        objs[owners.index(me)].get_local_ref().resolve(timeout=backstop)
+    )
+    return rnd.run(local_value)

@@ -634,6 +634,7 @@ def run_quorum_rounds(
     checkpoint_every: int = 0,
     wire_quant: Optional[str] = None,
     secure_agg: bool = False,
+    region_size: Optional[int] = None,
 ) -> Any:
     """The quorum-mode round loop behind ``run_fedavg_rounds(quorum=k)``.
 
@@ -641,7 +642,14 @@ def run_quorum_rounds(
 
     - aggregation is always the quorum-aware streaming round
       (:func:`quorum_aggregate`); ``mode="ring"`` tries the ring first
-      and falls back to it when the ring aborts;
+      and falls back to it when the ring aborts; ``mode="hierarchy"``
+      (requires ``wire_quant`` + ``region_size``) tries the two-level
+      region topology (:mod:`rayfed_tpu.fl.hierarchy`) first — a
+      hierarchy abort (e.g. a dead region coordinator) re-aggregates
+      the SAME round over the flat quorum path, where the cutoff
+      excludes the corpse, the announcement drops it, and a dead
+      QUORUM coordinator reaches this driver's ``roster_successor``
+      failover arm like always;
     - each party's next-round input is the broadcast aggregate — except
       a straggler's, which is ``dga_correct(agg, update, input)`` so its
       missed progress folds into the next round;
@@ -721,6 +729,23 @@ def run_quorum_rounds(
             "quorum ring has not been taught the quantized stripe "
             "shape), never a silent fallback"
         )
+    if mode == "hierarchy":
+        if wire_quant is None:
+            raise QuorumRoundError(
+                "mode='hierarchy' requires wire_quant — hierarchical "
+                "aggregation is compressed-domain only (fl.hierarchy)"
+            )
+        if region_size is None or int(region_size) < 1:
+            raise QuorumRoundError(
+                "mode='hierarchy' requires region_size= (the "
+                "deterministic partition width)"
+            )
+        if secure_agg:
+            raise QuorumRoundError(
+                "mode='hierarchy' and secure_agg are mutually "
+                "exclusive — pairwise masks only cancel over the full "
+                "party set (fl.hierarchy)"
+            )
     secagg_keys = None
     if secure_agg:
         if wire_quant is None:
@@ -895,6 +920,16 @@ def run_quorum_rounds(
                 round_grid = qz.make_round_grid(
                     quant_prev_delta, wire_dtype=wire_quant,
                     mode="delta", expand=qz.QUANT_DELTA_EXPAND,
+                    # The grid chunking IS the hierarchy's region
+                    # stripe chunking (ring_chunk_elems doubles as the
+                    # override, exactly as in the classic loop) — a
+                    # default-chunked grid over a small model would
+                    # collapse to ~1 block and degenerate every region
+                    # ring to a single stripe owner.
+                    chunk_elems=(
+                        ring_chunk_elems if mode == "hierarchy"
+                        else None
+                    ),
                 )
         rec = None
         if timings is not None:
@@ -938,6 +973,7 @@ def run_quorum_rounds(
                     stream=_effective_stream(stream, coord, coord0),
                     epoch=epoch, mode=mode,
                     ring_chunk_elems=ring_chunk_elems,
+                    region_size=region_size,
                     announce_fn=announce_fn, backstop=backstop,
                     active=active, timings=rec,
                     quant=round_grid, quant_ref=round_ref,
@@ -1063,13 +1099,15 @@ def _aggregate_with_mode(
     runtime, updates, w_map, *, session, round_index, quorum, deadline_s,
     coordinator, stream, epoch, mode, ring_chunk_elems, announce_fn,
     backstop, active, timings, quant=None, quant_ref=None,
-    quant_scope=None, secagg=None,
+    quant_scope=None, secagg=None, region_size=None,
 ) -> QuorumRoundOutcome:
-    """Ring-first aggregation when ``mode="ring"``: a straggler or dead
-    party aborts the ring on every controller (poison cascade + commit
-    ring), and the SAME round re-aggregates over the coordinator
-    topology with the quorum cutoff — the straggler is excluded there
-    instead of failing the round."""
+    """Topology-first aggregation when ``mode`` is ``"ring"`` or
+    ``"hierarchy"``: a straggler or dead party aborts the topology
+    round on every controller (poison cascade + commit pass), and the
+    SAME round re-aggregates over the coordinator topology with the
+    quorum cutoff — the straggler is excluded there instead of failing
+    the round, and a dead quorum coordinator reaches the driver's
+    ``roster_successor`` failover arm."""
     from rayfed_tpu.proxy import recv_on_runtime
 
     me = runtime.party
@@ -1083,6 +1121,60 @@ def _aggregate_with_mode(
             "quantized quorum rounds run the coordinator topology — "
             "mode='ring' with quant= is not supported"
         )
+
+    def _announce_after_topology(result) -> QuorumRoundOutcome:
+        """Roster transition after a successful ring/hierarchy round:
+        neither topology's result broadcast carries announcements, so a
+        tiny announce frame rides after every such round (usually
+        ``{"a": None}``)."""
+        members = list(active)
+        announce = None
+        welcomes: list = []
+        if me == coordinator:
+            try:
+                if announce_fn is not None:
+                    announce, welcomes = announce_fn(members)
+            except BaseException as exc:
+                # Peers are about to park on the announce key — they
+                # must hear the coordinator-side failure (e.g. a
+                # no-successor coordinator fed.leave) now, not at
+                # backstop.
+                _poison_round_key(
+                    runtime, [p for p in active if p != me],
+                    f"{down}.ann", down, exc,
+                )
+                raise
+            chaos.fire(
+                "announce", party=me, round=round_index, epoch=epoch
+            )
+            refs = runtime.send_proxy.send_many(
+                [p for p in active if p != me],
+                {"a": announce}, f"{down}.ann", down,
+                round_tag=round_index, epoch_tag=epoch,
+            )
+            for p, ref in refs.items():
+                if not ref.resolve(timeout=backstop):
+                    logger.warning(
+                        "round %d: announce to %s failed",
+                        round_index, p,
+                    )
+        else:
+            try:
+                ann = recv_on_runtime(
+                    runtime, coordinator, f"{down}.ann", down
+                ).resolve(timeout=backstop)
+            except BaseException as exc:
+                # Uniform failure type: a coordinator dying between
+                # topology assembly and its announce must reach the
+                # driver's failover arm like any other
+                # coordinator-death, not as a bare RemoteError.
+                raise QuorumRoundError(
+                    f"round {round_index}: announce from coordinator "
+                    f"{coordinator!r} failed: {exc!r}"
+                ) from exc
+            announce = ann.get("a")
+        return QuorumRoundOutcome(result, members, announce, welcomes)
+
     if mode == "ring" and len(active) > 1:
         from rayfed_tpu.fl.ring import RING_STATS, RingRoundError, ring_aggregate
 
@@ -1101,56 +1193,7 @@ def _aggregate_with_mode(
                 expect_parties=active,
                 timings=timings,
             )
-            members = list(active)
-            # The ring has no coordinator broadcast to carry roster
-            # announcements, so a tiny announce frame rides after every
-            # successful ring round (usually {"a": None}).
-            announce = None
-            welcomes: list = []
-            if me == coordinator:
-                try:
-                    if announce_fn is not None:
-                        announce, welcomes = announce_fn(members)
-                except BaseException as exc:
-                    # Peers are about to park on the announce key —
-                    # they must hear the coordinator-side failure (e.g.
-                    # a no-successor coordinator fed.leave) now, not at
-                    # backstop.
-                    _poison_round_key(
-                        runtime, [p for p in active if p != me],
-                        f"{down}.ann", down, exc,
-                    )
-                    raise
-                chaos.fire(
-                    "announce", party=me, round=round_index, epoch=epoch
-                )
-                refs = runtime.send_proxy.send_many(
-                    [p for p in active if p != me],
-                    {"a": announce}, f"{down}.ann", down,
-                    round_tag=round_index, epoch_tag=epoch,
-                )
-                for p, ref in refs.items():
-                    if not ref.resolve(timeout=backstop):
-                        logger.warning(
-                            "round %d: announce to %s failed",
-                            round_index, p,
-                        )
-            else:
-                try:
-                    ann = recv_on_runtime(
-                        runtime, coordinator, f"{down}.ann", down
-                    ).resolve(timeout=backstop)
-                except BaseException as exc:
-                    # Uniform failure type: a coordinator dying between
-                    # ring assembly and its announce must reach the
-                    # driver's failover arm like any other
-                    # coordinator-death, not as a bare RemoteError.
-                    raise QuorumRoundError(
-                        f"round {round_index}: announce from coordinator "
-                        f"{coordinator!r} failed: {exc!r}"
-                    ) from exc
-                announce = ann.get("a")
-            return QuorumRoundOutcome(result, members, announce, welcomes)
+            return _announce_after_topology(result)
         except RingRoundError as exc:
             logger.warning(
                 "round %d: ring aborted (%s); re-aggregating the same "
@@ -1158,6 +1201,50 @@ def _aggregate_with_mode(
                 "cutoff", round_index, exc, quorum,
             )
             RING_STATS["fallback_rounds"] += 1
+            stream = f"{stream}.fb"
+    if mode == "hierarchy" and len(active) > 1 and quant is not None:
+        # Bootstrap rounds (no grid yet) fall straight through to the
+        # flat quorum path — hierarchy is compressed-domain only.
+        from rayfed_tpu.fl.hierarchy import (
+            HIER_STATS,
+            HierarchyRoundError,
+            hierarchy_aggregate,
+        )
+
+        try:
+            objs = [updates[p] for p in sorted(updates)]
+            result = hierarchy_aggregate(
+                objs,
+                None if w_map is None
+                else [w_map[p] for p in sorted(updates)],
+                region_size=int(region_size),
+                stream=f"{stream}/hier",
+                quant=quant, quant_ref=quant_ref,
+                quant_scope=quant_scope,
+                quant_downlink=True,
+                seq_ids=tuple(
+                    f"{down}.h{i}" for i in range(6)
+                ),
+                round_tag=round_index,
+                epoch=epoch,
+                timeout=(
+                    deadline_s if deadline_s is not None else backstop
+                ),
+                timings=timings,
+            )
+            return _announce_after_topology(result)
+        except HierarchyRoundError as exc:
+            # A dead region coordinator (or root) aborts the hierarchy
+            # on every controller; the flat quorum re-run excludes the
+            # corpse via the deadline-gated cutoff, the announcement
+            # drops it from the roster, and a dead QUORUM coordinator
+            # reaches the existing roster_successor failover arm.
+            logger.warning(
+                "round %d: hierarchy aborted (%s); re-aggregating the "
+                "same round over the coordinator topology with quorum "
+                "%d cutoff", round_index, exc, quorum,
+            )
+            HIER_STATS["fallback_rounds"] += 1
             stream = f"{stream}.fb"
     return quorum_aggregate(
         runtime, updates, w_map, session=session, round_index=round_index,

@@ -70,14 +70,18 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 # Version of the ring stripe manifest ("rsm" sideband leaf) — bump when
-# make_stripe_meta's schema changes.  Fingerprinted (together with the
-# schema) by tool/check_wire_format.py: stripe payloads are a
-# cross-party contract layered on the ordinary payload manifest, so
-# drift must be deliberate.  The frame layout itself is untouched.
+# make_stripe_meta's schema OR SEMANTICS change.  Fingerprinted
+# (together with the schema) by tool/check_wire_format.py: stripe
+# payloads are a cross-party contract layered on the ordinary payload
+# manifest, so drift must be deliberate.  The frame layout itself is
+# untouched.
 # History: 1 = original; 2 = optional "qg" field (the shared
 # quantization grid's fingerprint on compressed-domain "rs" stripes —
-# receivers cross-check it before folding integer codes).
-RING_STRIPE_VERSION = 2
+# receivers cross-check it before folding integer codes); 3 = "ag"
+# stripes of a compressed-domain round carry grid CODES (dt = the
+# grid's integer dtype, "qg" present) instead of f32 — the gather hop
+# is coded on the shared round grid (see ring_aggregate's quant docs).
+RING_STRIPE_VERSION = 3
 
 # Module-level round counters (mirrors rayfed_tpu.metrics' style of
 # cheap global accounting): the trainer's fallback path and tests read
@@ -175,6 +179,68 @@ def _stripe_elems(blocks: Sequence[int], chunk_elems: int, nblocks: int,
     return n
 
 
+def code_gather_stripe(
+    stripe, ref_slice, scales, zps, chunk_elems: int, wire_dtype: str
+) -> np.ndarray:
+    """Code a finalized f32 stripe onto the SHARED round grid's rows —
+    the quantized ring's gather-hop coding (ROADMAP 2a: the
+    reduce-scatter was already integer; this closes the f32 gather).
+
+    Mirrors the coordinator topology's ``quantize_downlink``: the
+    finalized stripe is the round's OUTPUT, so its coding error is the
+    same downlink-class error every quantized broadcast already
+    carries — and because the round grid is shared and the coding is
+    block-local (the same fused kernels ``fl.quantize`` compiles for
+    the full buffer, applied to the stripe's rows), every controller
+    decodes the identical bytes, and the assembled ring result equals
+    the full-buffer recode of the exact aggregate
+    (``quantize_packed(exact, grid, ref).dequantize(...)``) bit for
+    bit.  The stripe OWNER substitutes the decoded codes for its own
+    stripe too, so ring parties byte-agree by construction.
+    """
+    import jax.numpy as jnp
+
+    from rayfed_tpu.fl.quantize import _quantize_kernel
+
+    arr = np.asarray(stripe, np.float32).reshape(-1)
+    se = int(arr.size)
+    with_ref = ref_slice is not None
+    ref = (
+        np.asarray(ref_slice, np.float32).reshape(-1)
+        if with_ref else jnp.zeros(0, jnp.float32)
+    )
+    qbuf, _ = _quantize_kernel(
+        int(chunk_elems), se, str(wire_dtype), with_ref
+    )(arr, ref, np.asarray(scales, np.float32),
+      np.asarray(zps, np.float32), jnp.zeros(se, jnp.float32))
+    return np.asarray(qbuf)
+
+
+def decode_gather_stripe(
+    codes, ref_slice, scales, zps, chunk_elems: int, out_dtype
+) -> np.ndarray:
+    """Decode a gather-hop stripe's grid codes back to the output dtype
+    — the receiver half of :func:`code_gather_stripe` (identical on
+    every controller: shared grid rows + shared reference slice)."""
+    import jax.numpy as jnp
+
+    from rayfed_tpu.fl.quantize import _dequantize_kernel
+
+    arr = np.asarray(codes).reshape(-1)
+    se = int(arr.size)
+    with_ref = ref_slice is not None
+    ref = (
+        np.asarray(ref_slice, np.float32).reshape(-1)
+        if with_ref else jnp.zeros(0, jnp.float32)
+    )
+    out = _dequantize_kernel(
+        int(chunk_elems), se, str(arr.dtype), np.dtype(out_dtype).name,
+        with_ref,
+    )(arr, ref, np.asarray(scales, np.float32),
+      np.asarray(zps, np.float32))
+    return np.asarray(out)
+
+
 def _check_meta(meta_json: str, want: Dict[str, Any]) -> None:
     # "rsm", not "meta": this is the ring stripe manifest (a payload-
     # level contract fingerprinted via ring_stripe_schema), NOT frame
@@ -246,17 +312,24 @@ def ring_aggregate(
     and each stripe owner folds codes into a donated i32 accumulator
     with ONE fused rescale at finalize
     (:class:`~rayfed_tpu.fl.streaming.StripeAggregator` integer path).
-    The all-gather then carries the finalized float stripes (they are
-    the round's OUTPUT — re-coding them would quantize the mean, the
-    loss no residual compensates), so the quantized ring saves the
-    reduce-scatter half of the wire.  ``quant_ref``: the round's
+    The all-gather hop is coded on the SAME shared round grid
+    (:func:`code_gather_stripe` — each owner ships its finalized
+    stripe as grid codes, relays forward the codes, and every party
+    *owner included* assembles the decoded codes), so BOTH halves of
+    the ring round ride integer bytes.  The gather coding is the
+    ring's analogue of the coordinator path's quantized downlink: the
+    finalized stripes are the round's OUTPUT, so the (tiny,
+    grid-step-bounded) coding error is the same downlink-class error
+    every quantized broadcast already carries — and because the grid
+    is shared and coding is block-local, the assembled result is
+    byte-identical on every controller and equals the full-buffer
+    recode of the exact aggregate:
+    ``quantize_packed(packed_quantized_sum(...), grid,
+    ref).dequantize(...)``.  ``quant_ref``: the round's
     shared reference buffer for ``mode="delta"`` grids (parties code
     ``update − ref``; each stripe owner's finalize adds back its
-    compacted reference slice).  ``out_dtype`` defaults to f32;
-    the result is byte-identical to
-    :func:`~rayfed_tpu.fl.fedavg.packed_quantized_sum` over the same
-    contributions and therefore to the compressed-domain coordinator
-    topology.  ``quant_scope`` keys the per-process error-feedback
+    compacted reference slice).  ``out_dtype`` defaults to f32.
+    ``quant_scope`` keys the per-process error-feedback
     residual exactly as in ``streaming_aggregate`` — committed only
     when the round lands, so the coordinator fallback re-quantizes the
     SAME update with the SAME residual after a ring abort.
@@ -495,8 +568,11 @@ def ring_aggregate(
         total_elems = int(buf.size)
         nblocks = packed_block_grid(total_elems, chunk_elems)
         stripes = packed_stripe_schedule(nblocks, n)
-        # Compressed-domain output defaults to f32 — the finalized
-        # stripes are the round's OUTPUT, never re-coded.
+        # Compressed-domain output defaults to f32 — what every party
+        # RETURNS.  (The gather hop re-codes the finalized stripes on
+        # the shared round grid as a pure wire encoding — see the
+        # all-gather phase below — but every controller decodes back
+        # to this dtype, owner included.)
         out_dt = (
             np.dtype(out_dtype) if out_dtype is not None
             else (np.dtype(np.float32) if quant is not None else wire_dt)
@@ -620,8 +696,45 @@ def ring_aggregate(
             )
 
         # -- all-gather: reduced stripes travel the ring ---------------
+        # Compressed-domain rounds code the gather hop on the SHARED
+        # round grid (ROADMAP 2a — the reduce-scatter was already
+        # integer, the gather shipped f32): the owner codes its
+        # finalized stripe, ships + relays the integer codes, and
+        # every party (owner INCLUDED) assembles the decoded codes, so
+        # the ring result is byte-identical on every controller and
+        # equals the full-buffer recode of the exact aggregate — the
+        # ring's analogue of the coordinator path's quantized downlink.
         _maybe_fault("ag")
-        gathered: Dict[int, np.ndarray] = {m: np.asarray(my_reduced)}
+
+        def _gather_ctx(k: int):
+            rows_s, rows_z = quant.rows(stripes[k])
+            ref_slice = (
+                None if qref is None
+                else _stripe_slice(qref, stripes[k], chunk_elems,
+                                   total_elems)
+            )
+            return rows_s, rows_z, ref_slice
+
+        # The gather wire dtype is a round-wide contract: derived from
+        # the GRID alone, never from whether this party happens to own
+        # a stripe (a zero-stripe party still validates its peers'
+        # coded stripes against it).
+        ag_dt_name = (
+            quant.wire_dtype if quant is not None else out_dt.name
+        )
+        if quant is not None and my_stripe_elems:
+            rows_s, rows_z, ref_slice = _gather_ctx(m)
+            my_codes = code_gather_stripe(
+                my_reduced, ref_slice, rows_s, rows_z, chunk_elems,
+                quant.wire_dtype,
+            )
+            my_assembled = decode_gather_stripe(
+                my_codes, ref_slice, rows_s, rows_z, chunk_elems, out_dt
+            )
+        else:
+            my_codes = None
+            my_assembled = np.asarray(my_reduced)
+        gathered: Dict[int, np.ndarray] = {m: my_assembled}
         fwd_refs: List[tuple] = []
         fwd_lock = threading.Lock()
 
@@ -630,7 +743,8 @@ def ring_aggregate(
                 "data": data,
                 "rsm": json.dumps(
                     make_stripe_meta(
-                        k, n, nblocks, total_elems, out_dt.name, "ag"
+                        k, n, nblocks, total_elems, ag_dt_name, "ag",
+                        qgrid_fp=q_fp,
                     ),
                     sort_keys=True,
                 ),
@@ -648,7 +762,12 @@ def ring_aggregate(
                 fwd_refs.append((k, hop, ref))
 
         if elems(m):
-            _ag_send(m, 1, _ag_payload(m, gathered[m]))
+            _ag_send(
+                m, 1,
+                _ag_payload(
+                    m, my_codes if my_codes is not None else gathered[m]
+                ),
+            )
 
         collected: Dict[int, Any] = {}
         for k in sorted(
@@ -661,11 +780,16 @@ def ring_aggregate(
                 # "el" is the FULL buffer's element count (the grid the
                 # stripe indexes into); the stripe's own length follows
                 # from the schedule and is re-checked at assembly.
-                _check_meta(
-                    value["rsm"],
-                    {"s": k, "n": n, "nb": nblocks, "el": total_elems,
-                     "dt": out_dt.name, "ph": "ag"},
-                )
+                ag_want = {
+                    "s": k, "n": n, "nb": nblocks, "el": total_elems,
+                    "dt": ag_dt_name, "ph": "ag",
+                }
+                if q_fp is not None:
+                    # Gather codes mean nothing without the grid —
+                    # prove both ends derived the identical one before
+                    # any decode (and before the relay hop).
+                    ag_want["qg"] = q_fp
+                _check_meta(value["rsm"], ag_want)
                 if hop + 1 <= n - 1:  # successor is not stripe k's owner
                     _ag_send(k, hop + 1, value)
                 return value
@@ -679,7 +803,13 @@ def ring_aggregate(
 
         for k, ref in collected.items():
             value = ref.resolve(timeout=backstop)
-            gathered[k] = np.asarray(value["data"]).reshape(-1)
+            arr = np.asarray(value["data"]).reshape(-1)
+            if quant is not None:
+                rows_s, rows_z, ref_slice = _gather_ctx(k)
+                arr = decode_gather_stripe(
+                    arr, ref_slice, rows_s, rows_z, chunk_elems, out_dt
+                )
+            gathered[k] = arr
             if k == 0 and "pt" in value:
                 reduced_pt = tuple(value["pt"])
         with fwd_lock:

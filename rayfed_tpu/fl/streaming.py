@@ -172,6 +172,7 @@ class StreamingAggregator:
         quant_ref: Optional[Any] = None,
         masked: bool = False,
         mask_recovery: Optional[Any] = None,
+        presummed: Optional[str] = None,
     ) -> None:
         if n_sources < 1:
             raise ValueError("streaming aggregation needs >= 1 source")
@@ -274,6 +275,36 @@ class StreamingAggregator:
             )
         if mask_recovery is not None and not self._masked:
             raise ValueError("mask_recovery only applies with masked=True")
+        # Hierarchical aggregation (fl.hierarchy): sources are REGION
+        # PARTIAL SUMS ``Σ_{p∈region} w_p·q_p`` (RegionSumTree) rather
+        # than per-party codes — the weights are already folded in, so
+        # each source folds at UNIT weight through the unchanged
+        # integer kernel (integer adds are exact + associative, which
+        # is what makes hierarchical == flat byte-identical).  The
+        # ``weights`` passed here are the per-region integer TOTALS,
+        # so Σw (the finalize divisor and zero-point term) is the
+        # whole roster's weight — exactly the flat fold's.
+        # ``presummed`` names the partial-sum wire dtype (int16/int32,
+        # fl.hierarchy.partial_sum_dtype — the narrowest integer that
+        # holds qabs_max·W exactly).
+        self._presummed = None if presummed is None else str(presummed)
+        if self._presummed is not None:
+            if quant is None:
+                raise ValueError(
+                    "presummed aggregation requires quant= (the round's "
+                    "shared grid) — partial sums live in its integer "
+                    "domain"
+                )
+            if self._masked:
+                raise ValueError(
+                    "presummed and masked are mutually exclusive (a "
+                    "region partial sum is already an unmaskable fold)"
+                )
+            if np.dtype(self._presummed).kind != "i":
+                raise ValueError(
+                    f"presummed= names the partial-sum integer wire "
+                    f"dtype, got {self._presummed!r}"
+                )
         self._n = n_sources
         self._streams = [_Stream() for _ in range(n_sources)]
         # Quorum (k-of-n) mode: the first k completed contributions may
@@ -351,6 +382,22 @@ class StreamingAggregator:
                         "got a MaskedCodeTree but this aggregator is "
                         "not masked — construct it with masked=True "
                         "(fl.secagg) or send plain quantized codes"
+                    )
+                )
+                return
+            from rayfed_tpu.fl.hierarchy import RegionSumTree
+
+            if (self._presummed is not None) != isinstance(
+                packed_tree, RegionSumTree
+            ):
+                self.fail(
+                    TypeError(
+                        "presummed fold got a per-party contribution "
+                        "(expected a RegionSumTree partial sum)"
+                        if self._presummed is not None else
+                        "got a RegionSumTree but this aggregator is "
+                        "not presummed — construct it with presummed= "
+                        "(fl.hierarchy) or send per-party codes"
                     )
                 )
                 return
@@ -764,19 +811,28 @@ class StreamingAggregator:
         self._wire_dtype = s.dtype
         if self._quant is not None:
             # Masked rounds widen the grid codes to i32 (the mod-2³²
-            # ring the pairwise masks live in — fl.secagg); plain
-            # quantized rounds carry the grid's own integer width.
+            # ring the pairwise masks live in — fl.secagg); presummed
+            # (hierarchy) rounds carry region partial sums at the
+            # narrowest exact integer width; plain quantized rounds
+            # carry the grid's own integer width.
             from rayfed_tpu.fl.secagg import MASKED_WIRE_DTYPE
 
-            want_dt = (
-                MASKED_WIRE_DTYPE if self._masked
-                else self._quant.wire_dtype
-            )
+            if self._masked:
+                want_dt = MASKED_WIRE_DTYPE
+            elif self._presummed is not None:
+                want_dt = self._presummed
+            else:
+                want_dt = self._quant.wire_dtype
             if s.dtype != np.dtype(want_dt):
+                mode_name = (
+                    "masked" if self._masked
+                    else "presummed" if self._presummed is not None
+                    else "plain"
+                )
                 raise ValueError(
                     f"compressed-domain contribution carries "
                     f"{s.dtype} codes, this round folds {want_dt} "
-                    f"({'masked' if self._masked else 'plain'} mode) — "
+                    f"({mode_name} mode) — "
                     f"sender and receiver disagree on the round shape"
                 )
             if (
@@ -968,10 +1024,12 @@ class StreamingAggregator:
                     )
             for i, lo, hi, src in work:
                 s = self._streams[i]
-                if self._masked:
+                if self._masked or self._presummed is not None:
                     # The party folded its own weight into the masked
-                    # codes; pairwise masks only cancel at unit fold
-                    # weight (fl.secagg).
+                    # codes (pairwise masks only cancel at unit fold
+                    # weight — fl.secagg), and a region partial sum
+                    # already carries Σ w_p·q_p (fl.hierarchy) — both
+                    # fold at unit weight.
                     w = np.int32(1)
                 elif self._int_weights is not None:
                     w = np.int32(self._int_weights[i])
@@ -1116,6 +1174,7 @@ class StreamingAggregator:
         payload (retained as a zero-copy view — decode is cheap) must
         be a QuantizedPackedTree coded on exactly the round grid.
         Local contributions were checked at ``add_local``."""
+        from rayfed_tpu.fl.hierarchy import RegionSumTree
         from rayfed_tpu.fl.quantize import QuantizedPackedTree
         from rayfed_tpu.fl.secagg import MaskedCodeTree
 
@@ -1138,6 +1197,16 @@ class StreamingAggregator:
                     f"this round folds "
                     f"{'masked' if self._masked else 'plain'} codes — "
                     f"all parties must agree on secure_agg for the round"
+                )
+            if (self._presummed is not None) != isinstance(
+                tree, RegionSumTree
+            ):
+                raise TypeError(
+                    f"contribution from {self._labels[i]} is "
+                    f"{'a per-party code tree' if self._presummed is not None else 'a RegionSumTree partial sum'}"
+                    f" but this fold is "
+                    f"{'presummed' if self._presummed is not None else 'per-party'}"
+                    f" — hierarchy levels must agree on the round shape"
                 )
             if tree.gmeta != want:
                 raise ValueError(

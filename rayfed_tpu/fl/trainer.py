@@ -75,6 +75,7 @@ def run_fedavg_rounds(
     overlap: bool = False,
     timings: Optional[list] = None,
     ring_chunk_elems: Optional[int] = None,
+    region_size: Optional[int] = None,
     quorum: Optional[int] = None,
     round_deadline_s: Optional[float] = None,
     join_ticket: Optional[dict] = None,
@@ -190,7 +191,24 @@ def run_fedavg_rounds(
       death, poisoned hop), EVERY controller sees the abort (poison
       cascade + commit ring) and the driver re-aggregates the same
       round's updates over the coordinator topology — the round's
-      training work is never lost.
+      training work is never lost.  ``"hierarchy"`` scales past what
+      one flat structure can carry (:mod:`rayfed_tpu.fl.hierarchy`):
+      the sorted roster partitions deterministically into regions of
+      ``region_size``, each region runs the chunk-striped ring
+      reduce-scatter internally, region coordinators stream integer
+      partial sums up to a root, and ONE fused rescale finalizes —
+      per-party traffic stays ~2·|model| and no node at any level
+      sees O(N) ingress, with the aggregate BYTE-identical to the
+      flat compressed-domain fold (integer adds are exact and
+      associative).  Requires ``wire_quant`` (hierarchical float sums
+      are a loud exclusion) and ``region_size``; the bootstrap round
+      (no grid yet) runs the flat streaming path; a mid-round abort
+      falls back to flat streaming (classic loop) or the quorum
+      coordinator path (``quorum=``) for the SAME round, in lockstep.
+    - ``region_size``: the deterministic partition width of
+      ``mode="hierarchy"`` (regions are contiguous slices of the
+      sorted roster — every controller derives the identical partition
+      from the identical roster epoch, no negotiation).
     - ``coordinator``: which party anchors coordinator-mode rounds and
       ring fallbacks (default: the canonically-first — ``min`` — party).
       Exposed mainly for tests and for deployments whose first party is
@@ -305,11 +323,16 @@ def run_fedavg_rounds(
                 "packed_wire=True (the quantized unit is the packed "
                 "wire buffer)"
             )
-        if not streaming_agg and mode != "ring" and quorum is None:
+        if (
+            not streaming_agg
+            and mode not in ("ring", "hierarchy")
+            and quorum is None
+        ):
             raise ValueError(
-                "wire_quant requires streaming_agg=True, mode='ring' "
-                "or quorum= — the compressed-domain fold lives in the "
-                "streaming/striped aggregators (fl.quantize)"
+                "wire_quant requires streaming_agg=True, mode='ring', "
+                "mode='hierarchy' or quorum= — the compressed-domain "
+                "fold lives in the streaming/striped aggregators "
+                "(fl.quantize)"
             )
         if quorum is not None and mode == "ring":
             raise ValueError(
@@ -366,9 +389,58 @@ def run_fedavg_rounds(
             "packed_wire=True (the residual is carried on the packed "
             "wire buffer)"
         )
-    if mode not in ("coordinator", "ring"):
+    if mode not in ("coordinator", "ring", "hierarchy"):
         raise ValueError(
-            f"unknown mode {mode!r}: expected 'coordinator' or 'ring'"
+            f"unknown mode {mode!r}: expected 'coordinator', 'ring' or "
+            f"'hierarchy'"
+        )
+    if mode == "hierarchy":
+        if wire_quant is None:
+            raise ValueError(
+                "mode='hierarchy' requires wire_quant: hierarchical "
+                "aggregation is compressed-domain ONLY (float partial "
+                "sums would re-associate a non-associative fold and "
+                "silently break hierarchical == flat byte-identity) — "
+                "pass e.g. wire_quant='uint8'"
+            )
+        if region_size is None or int(region_size) < 1:
+            raise ValueError(
+                "mode='hierarchy' requires region_size= (the "
+                "deterministic partition width of the sorted roster), "
+                f"got {region_size!r}"
+            )
+        if streaming_agg:
+            raise ValueError(
+                "mode='hierarchy' and streaming_agg are mutually "
+                "exclusive: the hierarchy replaces the flat hub "
+                "topology streaming_agg folds on (its fallback path "
+                "streams on its own) — drop streaming_agg"
+            )
+        if sample is not None and sample != len(trainers):
+            raise ValueError(
+                "mode='hierarchy' requires full participation: "
+                "sampling churns the region partition every round, "
+                "re-striping every region ring — use "
+                "mode='coordinator' for sampled rounds"
+            )
+        if secure_agg:
+            raise ValueError(
+                "mode='hierarchy' and secure_agg are mutually "
+                "exclusive: pairwise masks only cancel over the FULL "
+                "party set, so a region's partial sum would be "
+                "un-finalizable ring noise — loud exclusion, never "
+                "silent garbage"
+            )
+        if aggregator is not None:
+            raise ValueError(
+                "mode='hierarchy' and aggregator are mutually "
+                "exclusive (a custom reducer needs the raw per-party "
+                "values at one place)"
+            )
+    if region_size is not None and mode != "hierarchy":
+        raise ValueError(
+            "region_size only applies to mode='hierarchy' (it sets "
+            "the deterministic region partition width)"
         )
     if mode == "ring":
         if not (compress_wire and packed_wire):
@@ -402,10 +474,11 @@ def run_fedavg_rounds(
             f"coordinator {coordinator!r} is not a training party "
             f"({sorted(trainers)})"
         )
-    if ring_chunk_elems is not None and mode != "ring":
+    if ring_chunk_elems is not None and mode not in ("ring", "hierarchy"):
         raise ValueError(
-            "ring_chunk_elems only applies to mode='ring' (it sets the "
-            "ring stripe grid granularity)"
+            "ring_chunk_elems only applies to mode='ring' or "
+            "mode='hierarchy' (it sets the stripe/chunk grid "
+            "granularity)"
         )
     if quorum is not None:
         if not 1 <= int(quorum) <= len(trainers):
@@ -553,6 +626,7 @@ def run_fedavg_rounds(
             checkpoint_every=checkpoint_every,
             wire_quant=_qname if wire_quant is not None else None,
             secure_agg=secure_agg,
+            region_size=region_size,
         )
 
     if overlap:
@@ -714,7 +788,8 @@ def run_fedavg_rounds(
                     # ring_aggregate's chunk-match guard would abort
                     # (and silently fall back) every quantized round.
                     chunk_elems=(
-                        ring_chunk_elems if mode == "ring" else None
+                        ring_chunk_elems
+                        if mode in ("ring", "hierarchy") else None
                     ),
                     # Per-party deltas overshoot the aggregate delta
                     # (the mean averages them down) — give the grid
@@ -742,7 +817,60 @@ def run_fedavg_rounds(
                 weight=_iw[active.index(me)],
             )
             round_masker.prefetch(round_grid.total_elems)
-        if mode == "ring":
+        if mode == "hierarchy":
+            from rayfed_tpu.fl.streaming import streaming_aggregate
+
+            if round_grid is None:
+                # Bootstrap round: no shared grid has been observed yet
+                # and hierarchy is compressed-domain only — run the
+                # flat streaming round (exactly the quantized loop's
+                # own bootstrap), hierarchical from the next round.
+                avg = streaming_aggregate(
+                    updates, weights, stream="fedavg",
+                    coordinator=coord, out_dtype=agg_out_dtype,
+                    timings=rec,
+                )
+            else:
+                from rayfed_tpu.fl.hierarchy import (
+                    HIER_STATS,
+                    HierarchyRoundError,
+                    hierarchy_aggregate,
+                )
+
+                try:
+                    avg = hierarchy_aggregate(
+                        updates, weights,
+                        region_size=int(region_size),
+                        stream="fedavg",
+                        quant=round_grid, quant_ref=round_ref,
+                        quant_scope="fedavg",
+                        # Quantize the broadcast down the tree too —
+                        # the downlink is the other half of the
+                        # round's bytes (shared quantize_downlink
+                        # producer).
+                        quant_downlink=True,
+                        round_tag=r, timings=rec,
+                    )
+                except HierarchyRoundError as e:
+                    # The abort reached every controller (tree-shaped
+                    # poison cascade + commit/release), so all of them
+                    # take this branch in lockstep: re-aggregate the
+                    # SAME round's updates over the flat streaming
+                    # path — owners still hold them, and the shared
+                    # RoundCodec re-quantizes with the SAME residual.
+                    logger.warning(
+                        "hierarchy round %d aborted (%s); falling back "
+                        "to flat streaming aggregation at %r", r, e,
+                        coord,
+                    )
+                    HIER_STATS["fallback_rounds"] += 1
+                    avg = streaming_aggregate(
+                        updates, weights, stream="fedavg",
+                        coordinator=coord, timings=rec,
+                        quant=round_grid, quant_ref=round_ref,
+                        quant_scope="fedavg",
+                    )
+        elif mode == "ring":
             from rayfed_tpu.fl.ring import (
                 RING_STATS,
                 RingRoundError,

@@ -39,6 +39,9 @@ _INTERNAL_ALLOWED = {
     # Secure aggregation: the masked wire form (i32 codes on the shared
     # grid — rayfed_tpu.fl.secagg).
     ("rayfed_tpu.fl.secagg", "MaskedCodeTree"),
+    # Hierarchical aggregation: a region's integer partial sum on the
+    # shared grid (rayfed_tpu.fl.hierarchy).
+    ("rayfed_tpu.fl.hierarchy", "RegionSumTree"),
     ("jax._src.tree_util", "default_registry"),
 }
 

@@ -20,7 +20,7 @@ import concurrent.futures
 import logging
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
 from rayfed_tpu.executor import LocalRef
@@ -206,6 +206,31 @@ def roster_successor(
         if p in candidates and p not in skip:
             return p
     return None
+
+
+def partition_regions(
+    members: Sequence[str], region_size: int
+) -> List[List[str]]:
+    """Deterministic two-level partition of the roster into regions.
+
+    Contiguous slices of the **sorted** member list, ``region_size``
+    parties each (last region short) — the same canonical order every
+    other cross-controller decision uses (sampling, stripe ownership,
+    ring neighbors), so every controller derives the identical
+    partition from the identical roster epoch with zero negotiation.
+    The hierarchy topology (:mod:`rayfed_tpu.fl.hierarchy`) builds on
+    this: region ``g`` runs its own chunk-striped ring, region
+    coordinators carry integer partial sums up to the root.
+    """
+    if int(region_size) < 1:
+        raise ValueError(
+            f"region_size must be >= 1, got {region_size}"
+        )
+    ps = sorted(members)
+    if not ps:
+        raise ValueError("cannot partition an empty roster")
+    s = int(region_size)
+    return [ps[i : i + s] for i in range(0, len(ps), s)]
 
 
 def ring_neighbors(parties: Sequence[str], party: str) -> tuple:

@@ -31,6 +31,22 @@ python -m tool.fedlint
 # lock re-pinned) whenever the layout changes.
 JAX_PLATFORMS=cpu python tool/check_wire_format.py
 
+# Which secure-aggregation suite this host actually exercises: the
+# x25519/AES paths need the optional `cryptography` wheel (now part of
+# the test/dev extras); without it the stdlib fallback (per-session
+# nonce + group key, numpy Philox PRG) is what runs and the
+# x25519/AES-specific tests skip LOUDLY — this line makes that skip
+# visible in every CI log instead of buried in the pytest summary.
+JAX_PLATFORMS=cpu python -c "
+from rayfed_tpu.transport import secagg
+ka = secagg.KeyAgreement('ci-suite-probe')
+print('secagg suite under test: kex=%s prg=%s%s' % (
+    ka.kex_scheme, ka.prg_scheme,
+    '' if secagg.HAVE_X25519 else
+    '  [stdlib fallback — cryptography wheel unavailable; '
+    'x25519/AES suite tests will skip loudly]'))
+"
+
 JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 
 # Fast bench smoke: drives the streaming-aggregation + delta-cache
@@ -68,6 +84,17 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # advanced >= 2 (both corpses dropped without any runtime restart),
 # and coordinator_failovers >= 1 on every survivor (the killed round
 # was re-established at the deterministic successor).
+# HIERARCHY gates (traffic-vs-N flatness, fl.hierarchy): at
+# N ∈ {4, 16, 64} in-process virtual parties (2 regions, region rings
+# + quantized cross-region partial-sum streaming), every N must hold
+# (1) hier_bitexact — the hierarchical aggregate BYTE-identical to the
+# one-shot packed_quantized_sum over all N contributions, (2)
+# hier_party_bytes_frac_N <= 1.25 — mean per-party bytes-on-wire within
+# 1.25x of 2·|model| (the flat-traffic budget: one contribution out,
+# one broadcast in), and (3) hier_ingress_flatness <= 1.6 — the
+# max-ingress-at-any-node ratio between N=64 and N=4 stays ~flat (no
+# O(N) hub at ANY level; the flat hub's coordinator ingress scales
+# ~N/2x over the same range).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

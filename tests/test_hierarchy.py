@@ -1,0 +1,631 @@
+"""Hierarchical aggregation (fl.hierarchy): region rings + quantized
+cross-region partial-sum streaming.
+
+All in-process per the tier-1 budget note: the data plane is driven
+through bare ``TransportManager`` VIRTUAL parties (threads in one
+process, real loopback sockets) — exactly the object the fed driver,
+the traffic bench and these tests share (``HierarchyRound``), so no
+party subprocesses are spawned.  The driver-level e2e legs ride the
+EXISTING trainer children (tests/test_streaming_agg.py).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+from rayfed_tpu.fl import compression as fl_comp
+from rayfed_tpu.fl import fedavg
+from rayfed_tpu.fl import hierarchy as H
+from rayfed_tpu.fl import quantize as qz
+from rayfed_tpu.fl.streaming import StreamingAggregator
+from rayfed_tpu.transport.manager import TransportManager, partition_regions
+
+from .multiproc import get_free_ports
+from .test_quantized_agg import _payload_of
+
+CE = 1 << 9  # 512-element blocks: many blocks on toy buffers
+
+
+# ---------------------------------------------------------------------------
+# Deterministic partition + layout (pure functions)
+# ---------------------------------------------------------------------------
+
+
+def test_partition_regions_deterministic_and_validates():
+    # Input order must not matter: the partition derives from the
+    # SORTED roster (the canonical cross-controller order).
+    a = partition_regions(["d", "a", "c", "b"], 2)
+    b = partition_regions(["a", "b", "c", "d"], 2)
+    assert a == b == [["a", "b"], ["c", "d"]]
+    assert partition_regions(["a", "b", "c", "d", "e"], 2) == [
+        ["a", "b"], ["c", "d"], ["e"],
+    ]
+    assert partition_regions(["a"], 4) == [["a"]]
+    with pytest.raises(ValueError, match="region_size"):
+        partition_regions(["a"], 0)
+    with pytest.raises(ValueError, match="empty"):
+        partition_regions([], 2)
+
+
+def test_partition_determinism_under_roster_churn():
+    """The partition is a pure function of the roster epoch's member
+    set: same roster → same partition (any input order), advanced
+    roster → a DIFFERENT partition whose fingerprint no longer
+    matches — which is what makes stale-region frames detectable."""
+    before = ["a", "b", "c", "d"]
+    after = ["a", "b", "d"]  # c dropped at an epoch advance
+    assert partition_regions(before, 2) != partition_regions(after, 2)
+    assert (
+        H.members_fingerprint(before)
+        != H.members_fingerprint(after)
+    )
+    # Fingerprints are order-independent (canonical sorted roster).
+    assert H.members_fingerprint(["d", "a", "b", "c"]) == (
+        H.members_fingerprint(before)
+    )
+
+
+def test_region_layout_dead_coordinator_fails_over_via_successor():
+    members = ["a", "b", "c", "d"]
+    lay = H.region_layout(members, 2)
+    assert lay.coordinators == {0: "a", 1: "c"}
+    assert lay.root == "a" and lay.active == [0, 1]
+    # Region coordinator dead -> roster_successor picks the next live
+    # member of the SAME region; partition itself is unchanged.
+    lay2 = H.region_layout(members, 2, dead=["c"])
+    assert lay2.regions == lay.regions
+    assert lay2.coordinators == {0: "a", 1: "d"}
+    # Root dead -> its region fails over AND the root lease moves.
+    lay3 = H.region_layout(members, 2, dead=["a"])
+    assert lay3.coordinators == {0: "b", 1: "c"}
+    assert lay3.root == "b"
+    # A fully-dead region drops out of the active set.
+    lay4 = H.region_layout(members, 2, dead=["c", "d"])
+    assert lay4.active == [0] and lay4.root == "a"
+    with pytest.raises(H.HierarchyRoundError, match="no live party"):
+        H.region_layout(members, 2, dead=members)
+
+
+def test_partial_sum_dtype_narrowest_exact():
+    assert H.partial_sum_dtype(255, 4) == "int16"
+    assert H.partial_sum_dtype(255, 128) == "int16"  # 32640 <= 32767
+    assert H.partial_sum_dtype(255, 129) == "int32"
+    assert H.partial_sum_dtype(255, 8_000_000) == "int32"
+    with pytest.raises(ValueError, match="overflow"):
+        H.partial_sum_dtype(255, 9_000_000)
+
+
+def test_region_meta_schema_and_check():
+    meta = H.make_region_meta(
+        "rs", 1, 3, 0, 2, 9, 4100, "uint8", qgrid_fp=123,
+        members_fp=H.members_fingerprint(["a", "b"]), epoch=4,
+    )
+    want = dict(meta)
+    want.pop("v")
+    import json
+
+    H.check_region_meta(json.dumps(meta), want)
+    # A churned roster (different fingerprint) fails loudly BEFORE any
+    # block folds — the stale-region detector.
+    stale = dict(want)
+    stale["mf"] = H.members_fingerprint(["a", "b", "c"])
+    with pytest.raises(H.HierarchyRoundError, match="mf="):
+        H.check_region_meta(json.dumps(meta), stale)
+    with pytest.raises(H.HierarchyRoundError, match="ep="):
+        H.check_region_meta(json.dumps(meta), {**want, "ep": 5})
+    with pytest.raises(H.HierarchyRoundError, match="understands up to"):
+        H.check_region_meta(
+            json.dumps({**meta, "v": H.HIERARCHY_VERSION + 1}), want
+        )
+
+
+# ---------------------------------------------------------------------------
+# RegionSumTree + presummed fold validation (in-memory)
+# ---------------------------------------------------------------------------
+
+
+def _toy_round(n=4, size=4_000, seed=7):
+    rng = np.random.default_rng(seed)
+    ref = rng.normal(size=(size,)).astype(np.float32)
+    tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+    packeds = [
+        fl_comp.PackedTree(
+            (ref + 0.01 * rng.normal(size=(size,)).astype(np.float32)),
+            tmpl.passthrough, tmpl.spec,
+        )
+        for _ in range(n)
+    ]
+    grid = qz.make_round_grid(
+        0.01 * rng.normal(size=(size,)).astype(np.float32),
+        chunk_elems=CE, mode="delta", expand=4.0,
+    )
+    return ref, packeds, grid
+
+
+def _region_sum(qts, weights, grid, spec, ps_dtype="int16"):
+    acc = np.zeros(grid.total_elems, np.int64)
+    for w, qt in zip(weights, qts):
+        acc += int(w) * np.asarray(qt.buf).astype(np.int64)
+    from rayfed_tpu.fl.compression import PackSpec
+
+    return H.RegionSumTree(
+        acc.astype(np.dtype(ps_dtype)), grid.scales, grid.zps, (),
+        PackSpec(spec.entries, spec.treedef, ps_dtype), grid.meta(),
+    )
+
+
+def test_region_sum_tree_refuses_decode_and_pickles():
+    ref, packeds, grid = _toy_round(2)
+    qts = [qz.quantize_packed(p, grid, ref=ref) for p in packeds]
+    rs = _region_sum(qts, [1, 2], grid, qts[0].spec)
+    with pytest.raises(H.HierarchyRoundError, match="PARTIAL"):
+        rs.dequantize()
+    with pytest.raises(H.HierarchyRoundError, match="dequantize"):
+        rs.unpack()
+    # Wire roundtrip under the restricted unpickler (internal allowlist).
+    from rayfed_tpu.transport import wire
+
+    back = wire.decode_payload(_payload_of(rs), allowed={})
+    assert isinstance(back, H.RegionSumTree)
+    np.testing.assert_array_equal(
+        np.asarray(back.buf), np.asarray(rs.buf)
+    )
+    assert back.gmeta == rs.gmeta
+
+
+def test_presummed_aggregator_validation():
+    ref, packeds, grid = _toy_round(2)
+    with pytest.raises(ValueError, match="requires quant"):
+        StreamingAggregator(2, presummed="int16")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        StreamingAggregator(
+            2, chunk_elems=CE, quant=grid, quant_ref=ref,
+            masked=True, presummed="int32",
+        )
+    with pytest.raises(ValueError, match="integer wire dtype"):
+        StreamingAggregator(
+            2, chunk_elems=CE, quant=grid, quant_ref=ref,
+            presummed="float32",
+        )
+    # A per-party code tree must not slip into a presummed fold.
+    agg = StreamingAggregator(
+        1, weights=[3.0], chunk_elems=CE, quant=grid, quant_ref=ref,
+        presummed="int16",
+    )
+    agg.add_local(0, qz.quantize_packed(packeds[0], grid, ref=ref))
+    with pytest.raises(TypeError, match="presummed fold got"):
+        agg.result(timeout=10)
+    # ...and a RegionSumTree must not slip into a per-party fold.
+    qts = [qz.quantize_packed(p, grid, ref=ref) for p in packeds]
+    rs = _region_sum(qts, [1, 1], grid, qts[0].spec)
+    agg2 = StreamingAggregator(
+        1, chunk_elems=CE, quant=grid, quant_ref=ref
+    )
+    agg2.add_local(0, rs)
+    with pytest.raises(TypeError, match="not presummed"):
+        agg2.result(timeout=10)
+
+
+def test_presummed_fold_bitexact_vs_flat():
+    """Regrouped integer folds reassemble the flat accumulator exactly:
+    presummed(region sums) == packed_quantized_sum(all parties)."""
+    ref, packeds, grid = _toy_round(4)
+    ws = [3, 1, 2, 5]
+    qts = [qz.quantize_packed(p, grid, ref=ref) for p in packeds]
+    want = fedavg.packed_quantized_sum(qts, ws, ref=ref)
+    rs0 = _region_sum(qts[:2], ws[:2], grid, qts[0].spec)
+    rs1 = _region_sum(qts[2:], ws[2:], grid, qts[0].spec)
+    agg = StreamingAggregator(
+        2, weights=[float(sum(ws[:2])), float(sum(ws[2:]))],
+        chunk_elems=CE, quant=grid, quant_ref=ref, presummed="int16",
+        labels=["region 0", "region 1"],
+    )
+    agg.add_local(0, rs0)
+    agg.sink(1).on_complete(_payload_of(rs1))
+    got = agg.result(timeout=30)
+    np.testing.assert_array_equal(
+        np.asarray(got.buf), np.asarray(want.buf)
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-process virtual parties: the full data plane over real sockets
+# ---------------------------------------------------------------------------
+
+
+def _mk_manager(party, cluster_ports, options=None):
+    cc = ClusterConfig(
+        parties={
+            p: PartyConfig.from_dict({
+                "address": f"127.0.0.1:{port}",
+                **({"transport_options": options[p]}
+                   if options and p in options else {}),
+            })
+            for p, port in cluster_ports.items()
+        },
+        current_party=party,
+    )
+    return TransportManager(
+        cc,
+        JobConfig(
+            device_put_received=False,
+            zero_copy_host_arrays=True,
+            cross_silo_timeout_s=20,
+        ),
+    )
+
+
+class _Cluster:
+    """N in-process virtual parties (one TransportManager each)."""
+
+    def __init__(self, parties, options=None):
+        self.parties = list(parties)
+        ports = dict(zip(self.parties, get_free_ports(len(self.parties))))
+        self.mgrs = {
+            p: _mk_manager(p, ports, options) for p in self.parties
+        }
+        for m in self.mgrs.values():
+            m.start()
+
+    def stop(self):
+        for m in self.mgrs.values():
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+    def run_round(self, contribs, grid, ref, *, region_size, keys,
+                  weights=None, dead=(), stagger=None, epoch=None,
+                  quant_downlink=False, skip=()):
+        """Run one HierarchyRound on every (non-skipped) party thread;
+        returns ({party: result}, {party: exception})."""
+        results, errors = {}, {}
+
+        def run_party(p, i):
+            try:
+                rnd = H.HierarchyRound(
+                    self.mgrs[p], party=p, members=self.parties,
+                    region_size=region_size, grid=grid, quant_ref=ref,
+                    keys=keys, weights=weights, stream="ht",
+                    backstop=60, dead=dead, epoch=epoch,
+                    quant_downlink=quant_downlink,
+                )
+                if stagger:
+                    time.sleep(stagger[i % len(stagger)])
+                results[p] = rnd.run(contribs[p])
+            except BaseException as e:
+                errors[p] = e
+
+        threads = [
+            threading.Thread(target=run_party, args=(p, i), daemon=True)
+            for i, p in enumerate(self.parties)
+            if p not in set(dead) | set(skip)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        return results, errors
+
+
+PARTIES4 = ["p00", "p01", "p02", "p03"]
+
+
+@pytest.fixture()
+def cluster4():
+    c = _Cluster(PARTIES4)
+    yield c
+    c.stop()
+
+
+def _contribs(parties, ref, tmpl, seed0=100):
+    out = {}
+    for i, p in enumerate(parties):
+        rng = np.random.default_rng(seed0 + i)
+        out[p] = fl_comp.PackedTree(
+            ref + 0.01 * rng.normal(size=ref.shape).astype(np.float32),
+            tmpl.passthrough, tmpl.spec,
+        )
+    return out
+
+
+def _grid_for(ref, seed=0):
+    rng = np.random.default_rng(seed)
+    return qz.make_round_grid(
+        (0.01 * rng.standard_normal(ref.size)).astype(np.float32),
+        mode="delta", expand=4.0, chunk_elems=CE,
+    )
+
+
+def test_hierarchy_n4_bitexact_vs_flat_under_shuffled_arrival(cluster4):
+    """THE acceptance identity: hierarchy(N=4, regions=2) is
+    BYTE-identical to the flat streaming fold and to the one-shot
+    compressed-domain reduce (packed_quantized_sum — the quantized
+    sibling of packed_weighted_sum, whose per-party multiply-add chain
+    it is), under shuffled arrival order at every level."""
+    n = 4_100  # short tail block on the CE grid
+    ref = np.linspace(-0.5, 0.5, n).astype(np.float32)
+    tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+    grid = _grid_for(ref)
+    weights = {p: float(w) for p, w in zip(PARTIES4, [2, 1, 3, 1])}
+    contribs = _contribs(PARTIES4, ref, tmpl)
+    qts = [
+        qz.quantize_packed(contribs[p], grid, ref=ref) for p in PARTIES4
+    ]
+    want = fedavg.packed_quantized_sum(
+        qts, [weights[p] for p in PARTIES4], ref=ref
+    )
+    # Flat streaming fold over the identical codes (arrival shuffled).
+    flat = StreamingAggregator(
+        4, weights=[weights[p] for p in PARTIES4], chunk_elems=CE,
+        quant=grid, quant_ref=ref,
+    )
+    for i in (2, 0, 3):
+        flat.sink(i).on_complete(_payload_of(qts[i]))
+    flat.add_local(1, qts[1])
+    flat_got = flat.result(timeout=30)
+    np.testing.assert_array_equal(
+        np.asarray(flat_got.buf), np.asarray(want.buf)
+    )
+    for r, stagger in enumerate([(0.0, 0.02, 0.01), (0.03, 0.0, 0.0)]):
+        results, errors = cluster4.run_round(
+            contribs, grid, ref, region_size=2,
+            keys=[f"r{r}k{j}" for j in range(6)], weights=weights,
+            stagger=stagger,
+        )
+        assert not errors, errors
+        for p in PARTIES4:
+            assert (
+                np.asarray(results[p].buf).tobytes()
+                == np.asarray(want.buf).tobytes()
+            ), f"{p} round {r}: hierarchy != flat/one-shot"
+
+
+def test_hierarchy_quant_downlink_byte_agree(cluster4):
+    """With the re-quantized downlink, every party returns the
+    identical dequantized bytes — equal to the shared
+    quantize_downlink producer applied to the exact aggregate (the
+    same reference the flat streaming path asserts)."""
+    n = 4_096
+    ref = np.linspace(-0.2, 0.8, n).astype(np.float32)
+    tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+    grid = _grid_for(ref, seed=3)
+    contribs = _contribs(PARTIES4, ref, tmpl, seed0=500)
+    results, errors = cluster4.run_round(
+        contribs, grid, ref, region_size=2,
+        keys=[f"dk{j}" for j in range(6)], quant_downlink=True,
+    )
+    assert not errors, errors
+    qts = [
+        qz.quantize_packed(contribs[p], grid, ref=ref) for p in PARTIES4
+    ]
+    exact = fedavg.packed_quantized_sum(qts, ref=ref)
+    down = qz.make_round_grid(
+        np.asarray(exact.buf, np.float32) - ref,
+        chunk_elems=grid.chunk_elems, wire_dtype=grid.wire_dtype,
+        mode="delta",
+    )
+    expect = qz.quantize_packed(exact, down, ref=ref).dequantize(
+        np.float32, ref=ref
+    )
+    for p in PARTIES4:
+        assert (
+            np.asarray(results[p].buf).tobytes()
+            == np.asarray(expect.buf).tobytes()
+        ), p
+
+
+def test_hierarchy_uneven_regions_single_member_region():
+    """N=5 at region_size=2: regions [2, 2, 1] — the last region's
+    single member is its own coordinator and its 'ring' degenerates to
+    a local fold; byte-identity must hold regardless."""
+    parties = [f"q{i:02d}" for i in range(5)]
+    c = _Cluster(parties)
+    try:
+        n = 3_000
+        ref = np.zeros(n, np.float32)
+        tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+        grid = _grid_for(ref, seed=9)
+        weights = {p: float(i + 1) for i, p in enumerate(parties)}
+        contribs = _contribs(parties, ref, tmpl, seed0=900)
+        results, errors = c.run_round(
+            contribs, grid, ref, region_size=2,
+            keys=[f"u{j}" for j in range(6)], weights=weights,
+        )
+        assert not errors, errors
+        qts = [
+            qz.quantize_packed(contribs[p], grid, ref=ref)
+            for p in parties
+        ]
+        want = fedavg.packed_quantized_sum(
+            qts, [weights[p] for p in parties], ref=ref
+        )
+        for p in parties:
+            assert (
+                np.asarray(results[p].buf).tobytes()
+                == np.asarray(want.buf).tobytes()
+            ), p
+    finally:
+        c.stop()
+
+
+def test_hierarchy_refuses_passthrough_and_unquantized():
+    ref, packeds, grid = _toy_round(2)
+    with pytest.raises(H.HierarchyRoundError, match="compressed domain"):
+        H.HierarchyRound(
+            object(), party="a", members=["a", "b"], region_size=1,
+            grid=None, quant_ref=None, keys=["k"] * 6,
+        )
+    with pytest.raises(H.HierarchyRoundError, match="observer"):
+        H.HierarchyRound(
+            object(), party="z", members=["a", "b"], region_size=1,
+            grid=grid, quant_ref=ref, keys=["k"] * 6,
+        )
+    with pytest.raises(ValueError, match="rendezvous ids"):
+        H.HierarchyRound(
+            object(), party="a", members=["a", "b"], region_size=1,
+            grid=grid, quant_ref=ref, keys=["k"] * 3,
+        )
+
+
+def test_hierarchy_stale_epoch_frames_rejected_loudly():
+    """Epoch advance mid-round: a receiver whose roster moved to epoch
+    2 rejects epoch-1 hierarchy frames fatally (no retry ladder), and
+    the round aborts as HierarchyRoundError on every controller."""
+    parties = ["e00", "e01"]
+    c = _Cluster(parties)
+    try:
+        # e00 (coordinator + root) advanced two epochs; e01 still
+        # stamps epoch 1 — its reduce-scatter/partial-sum frames to
+        # e00 are stale-rejected on arrival.
+        c.mgrs["e00"].roster.advance(parties)
+        c.mgrs["e00"].roster.advance(parties)
+        n = 2_000
+        ref = np.zeros(n, np.float32)
+        tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+        grid = _grid_for(ref, seed=11)
+        contribs = _contribs(parties, ref, tmpl, seed0=50)
+        results, errors = c.run_round(
+            contribs, grid, ref, region_size=2,
+            keys=[f"se{j}" for j in range(6)], epoch=1,
+        )
+        assert set(errors) == set(parties), (results, errors)
+        for p, e in errors.items():
+            assert isinstance(e, H.HierarchyRoundError), (p, e)
+        assert (
+            c.mgrs["e00"].get_stats().get("receive_epoch_rejects", 0)
+            >= 1
+        )
+    finally:
+        c.stop()
+
+
+def test_hierarchy_region_coordinator_kill_failover():
+    """THE chaos test: hard-kill a region coordinator mid-round (its
+    transport dies, no goodbyes).  Every survivor aborts the round
+    loudly (tree-shaped poison cascade + peer-death fast-fail), the
+    re-run derives the region's new coordinator via roster_successor,
+    and the survivors byte-agree on the aggregate over the surviving
+    member set — exactly the packed_quantized_sum subset identity."""
+    victim = "p02"  # region 1's canonical coordinator (regions 2x2)
+    options = {victim: {
+        "heartbeat_interval_s": 0.3, "death_deadline_s": 0.9,
+    }}
+    c = _Cluster(PARTIES4, options=options)
+    try:
+        n = 3_000
+        ref = np.zeros(n, np.float32)
+        tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+        grid = _grid_for(ref, seed=21)
+        weights = {p: float(w) for p, w in zip(PARTIES4, [2, 1, 3, 1])}
+        contribs = _contribs(PARTIES4, ref, tmpl, seed0=300)
+
+        # Round 0, all alive: establishes cross-level reachability
+        # (the health monitor's fail-fast only covers parties that
+        # have proven reachable — exactly a real run's shape, where
+        # the kill lands mid-campaign, not before the first byte).
+        results, errors = c.run_round(
+            contribs, grid, ref, region_size=2,
+            keys=[f"c0{j}" for j in range(6)], weights=weights,
+        )
+        assert not errors, errors
+
+        def kill_at_up(phase, party):
+            if phase == "up" and party == victim:
+                # Hard kill: sockets die mid-round, no poison is sent.
+                c.mgrs[victim].stop()
+                raise RuntimeError("chaos: region coordinator killed")
+
+        H._fault_hook = kill_at_up
+        try:
+            results, errors = c.run_round(
+                contribs, grid, ref, region_size=2,
+                keys=[f"c1{j}" for j in range(6)], weights=weights,
+            )
+        finally:
+            H._fault_hook = None
+        # EVERY controller saw the abort (the victim's own error is a
+        # plain RuntimeError from the hook; survivors raise the wrapped
+        # round error).
+        assert set(errors) == set(PARTIES4), (results, errors)
+        for p in set(PARTIES4) - {victim}:
+            assert isinstance(errors[p], H.HierarchyRoundError), (
+                p, errors[p],
+            )
+
+        # The failover derivation every survivor shares: region 1's
+        # coordinator moves to the roster_successor-derived next live
+        # member.
+        lay = H.region_layout(PARTIES4, 2, dead=[victim])
+        assert lay.coordinators[1] == "p03"
+
+        # Re-run the SAME round over the survivors (the agreed dead
+        # set — at driver level the quorum fallback + epoch
+        # announcement carry this agreement).
+        survivors = [p for p in PARTIES4 if p != victim]
+        results, errors = c.run_round(
+            contribs, grid, ref, region_size=2,
+            keys=[f"c2{j}" for j in range(6)], weights=weights,
+            dead=[victim],
+        )
+        assert not errors, errors
+        qts = [
+            qz.quantize_packed(contribs[p], grid, ref=ref)
+            for p in survivors
+        ]
+        want = fedavg.packed_quantized_sum(
+            qts, [weights[p] for p in survivors], ref=ref
+        )
+        blobs = {
+            p: np.asarray(results[p].buf).tobytes() for p in survivors
+        }
+        assert len(set(blobs.values())) == 1, "survivors disagree"
+        assert blobs[survivors[0]] == np.asarray(want.buf).tobytes()
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# Driver validation (no runtime needed)
+# ---------------------------------------------------------------------------
+
+
+def test_run_fedavg_rounds_hierarchy_validation():
+    from rayfed_tpu.fl import run_fedavg_rounds
+
+    trainers = {"a": None, "b": None}
+    base = dict(compress_wire=True, packed_wire=True)
+    with pytest.raises(ValueError, match="requires wire_quant"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, mode="hierarchy", region_size=1,
+            **base,
+        )
+    with pytest.raises(ValueError, match="requires region_size"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, mode="hierarchy",
+            wire_quant="uint8", **base,
+        )
+    with pytest.raises(ValueError, match="streaming_agg are mutually"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, mode="hierarchy", region_size=1,
+            wire_quant="uint8", streaming_agg=True, **base,
+        )
+    with pytest.raises(ValueError, match="secure_agg are mutually"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, mode="hierarchy", region_size=1,
+            wire_quant="uint8", secure_agg=True, **base,
+        )
+    with pytest.raises(ValueError, match="region_size only applies"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, region_size=2, **base,
+        )
+    with pytest.raises(ValueError, match="full participation"):
+        run_fedavg_rounds(
+            trainers, {}, rounds=1, mode="hierarchy", region_size=1,
+            wire_quant="uint8", sample=1, **base,
+        )

@@ -339,8 +339,38 @@ def _run_parity(party, cluster, outdir):
     )
     assert np.array_equal(np.asarray(classic["w"]), np.asarray(quorate["w"]))
     assert all(sorted(e["members"]) == sorted(cluster) for e in log)
+
+    # Hierarchy x quorum composition (same child): quantized rounds run
+    # the two-level tree (region_size=1 -> one region per party, so the
+    # cross-region partial-sum streaming + announce frame all run for
+    # real), the bootstrap round stays the flat quorum path, and every
+    # controller must byte-agree.  A hierarchy abort would fall back to
+    # the flat quorum path — assert none was needed.
+    from rayfed_tpu.fl.hierarchy import HIER_STATS
+
+    done_before = HIER_STATS["rounds_completed"]
+    fb_before = HIER_STATS["fallback_rounds"]
+    hlog = []
+    hier = run_fedavg_rounds(
+        trainers, params, rounds=3, compress_wire=True, packed_wire=True,
+        mode="hierarchy", region_size=1, wire_quant="uint8",
+        # The chunk override must reach the quorum loop's grid
+        # derivation too (a default-chunked grid over this toy model
+        # would collapse to one block).
+        ring_chunk_elems=16,
+        quorum=len(cluster), round_deadline_s=30.0, round_log=hlog,
+    )
+    # Rounds 2..3 ran hierarchically (round 1 is the unquantized
+    # bootstrap), with zero fallbacks.
+    assert HIER_STATS["rounds_completed"] - done_before == 2
+    assert HIER_STATS["fallback_rounds"] == fb_before
+    assert all(sorted(e["members"]) == sorted(cluster) for e in hlog)
+
     with open(os.path.join(outdir, f"{party}.json"), "w") as f:
-        json.dump({"final": np.asarray(quorate["w"]).tolist()}, f)
+        json.dump({
+            "final": np.asarray(quorate["w"]).tolist(),
+            "hier_final": np.asarray(hier["w"]).tolist(),
+        }, f)
     fed.shutdown()
 
 
@@ -348,11 +378,15 @@ def test_quorum_full_participation_parity(tmp_path_factory):
     outdir = str(tmp_path_factory.mktemp("quorum_parity"))
     cluster = make_cluster(["alice", "bob"])
     run_parties(_run_parity, ["alice", "bob"], args=(cluster, outdir))
-    finals = []
+    finals, hier_finals = [], []
     for p in ("alice", "bob"):
         with open(os.path.join(outdir, f"{p}.json")) as f:
-            finals.append(json.load(f)["final"])
+            rec = json.load(f)
+        finals.append(rec["final"])
+        hier_finals.append(rec["hier_final"])
     assert finals[0] == finals[1]
+    # Hierarchy x quorum: every controller holds the identical bytes.
+    assert hier_finals[0] == hier_finals[1]
 
 
 def _run_coord_leave(party, cluster, outdir):

@@ -451,6 +451,83 @@ def _run_ring_party(party, cluster, parties):
     st = get_runtime().transport.get_stats()
     assert st["delta_logical_bytes"] > 0
 
+    # --- compressed-domain ring round (same child): BOTH hops ride
+    # integer bytes — the reduce-scatter folds uint8 codes, and the
+    # all-gather now ships the finalized stripes re-coded on the SAME
+    # shared round grid (ROADMAP 2a).  The gather coding is the ring's
+    # quantized downlink: every controller must byte-agree, and the
+    # result must equal the full-buffer recode of the exact
+    # compressed-domain aggregate.
+    from rayfed_tpu.fl import quantize as qz
+
+    qref = np.zeros(300_000 + 64, np.float32)
+    # Grid ranged like the contributions themselves (unit-scale normal
+    # leaves vs the zero reference), so the gather recode stays
+    # clip-free and the half-step error bound below is meaningful.
+    q_grid = qz.make_round_grid(
+        np.random.default_rng(5).normal(size=qref.shape)
+        .astype(np.float32),
+        mode="delta", expand=4.0, chunk_elems=1 << 12,
+    )
+    q_ws = [float(i + 1) for i in range(n)]  # integral example counts
+    qobjs = [
+        produce.party(p).remote(i + 1, 0.02)
+        for i, p in enumerate(parties)
+    ]
+    got_q = ring_aggregate(
+        qobjs, q_ws, stream="test-qring", chunk_elems=1 << 12,
+        quant=q_grid, quant_ref=qref,
+    )
+    q_qts = [
+        qz.quantize_packed(make_update(i + 1, 0.02), q_grid, ref=qref)
+        for i in range(n)
+    ]
+    q_exact = F.packed_quantized_sum(q_qts, q_ws, ref=qref)
+    q_expect = qz.quantize_packed(q_exact, q_grid, ref=qref).dequantize(
+        np.float32, ref=qref
+    )
+    assert (
+        np.asarray(got_q.buf).tobytes()
+        == np.asarray(q_expect.buf).tobytes()
+    ), "quantized-gather ring != round-grid recode of the exact sum"
+    np.testing.assert_array_equal(
+        np.asarray(got_q.passthrough[0]),
+        np.asarray(q_exact.passthrough[0]),
+    )
+    # The gather coding error is bounded by half a grid step.
+    q_err = np.abs(np.asarray(got_q.buf) - np.asarray(q_exact.buf))
+    assert float(q_err.max()) <= 0.5 * float(q_grid.scales.max()) + 1e-7
+
+    # Regression: with FEWER blocks than parties some stripes are
+    # EMPTY — a zero-stripe party must still validate/decode its
+    # peers' coded gather stripes (the gather dtype is a round-wide
+    # grid contract, not an owner-local one; deriving it from
+    # out_dtype used to abort every such round).
+    big_ce = 1 << 19  # 300_064 elems -> 1 block -> N-1 empty stripes
+    g_big = qz.make_round_grid(
+        np.random.default_rng(6).normal(size=qref.shape)
+        .astype(np.float32),
+        mode="delta", expand=4.0, chunk_elems=big_ce,
+    )
+    got_e = ring_aggregate(
+        [produce.party(p).remote(i + 1, 0.02)
+         for i, p in enumerate(parties)],
+        q_ws, stream="test-qring-e", chunk_elems=big_ce,
+        quant=g_big, quant_ref=qref,
+    )
+    e_qts = [
+        qz.quantize_packed(make_update(i + 1, 0.02), g_big, ref=qref)
+        for i in range(n)
+    ]
+    e_exact = F.packed_quantized_sum(e_qts, q_ws, ref=qref)
+    e_expect = qz.quantize_packed(e_exact, g_big, ref=qref).dequantize(
+        np.float32, ref=qref
+    )
+    assert (
+        np.asarray(got_e.buf).tobytes()
+        == np.asarray(e_expect.buf).tobytes()
+    ), "empty-stripe quantized ring != round-grid recode"
+
     # --- the round-loop driver in ring mode -----------------------------
     d, classes, nb = 16, 3, 128
 

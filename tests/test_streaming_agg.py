@@ -587,6 +587,32 @@ def _run_trainer_streaming(party, cluster):
     np.testing.assert_array_equal(
         np.asarray(got_q.buf), np.asarray(expect_q.buf)
     )
+
+    # --- hierarchical rounds over the same cluster (same child: the
+    # fed-API driver leg of fl.hierarchy; the topology-rich N=4/N=5
+    # paths are covered in-process in tests/test_hierarchy.py) --------
+    # region_size=1 puts each party in its own region: the cross-region
+    # partial-sum streaming + tree broadcast + commit pass all run for
+    # real, and the result must be byte-identical to the flat quantized
+    # streaming round (same grid, same quantize_downlink producer).
+    from rayfed_tpu.fl.hierarchy import HIER_STATS, hierarchy_aggregate
+
+    done_before = HIER_STATS["rounds_completed"]
+    got_h = hierarchy_aggregate(
+        objs, region_size=1, stream="test-hier", quant=grid,
+        quant_ref=ref_buf, quant_downlink=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_h.buf), np.asarray(expect_q.buf)
+    )
+    assert HIER_STATS["rounds_completed"] == done_before + 1
+    final_h = run_fedavg_rounds(
+        trainers, params, rounds=3,
+        compress_wire=True, packed_wire=True, mode="hierarchy",
+        region_size=1, wire_quant="uint8",
+    )
+    last_h = fed.get(trainers["alice"].loss.remote(final_h))
+    assert last_h < first, (first, last_h)
     fed.shutdown()
 
 

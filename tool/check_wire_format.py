@@ -146,10 +146,29 @@ def compute_fingerprint() -> str:
     )
     # Compressed-domain (v2) shape: "rs" stripes of a quantized round
     # additionally carry the shared grid's fingerprint — both shapes
-    # are contract, so both are fingerprinted.
+    # are contract, so both are fingerprinted.  (v3 extends the SAME
+    # shape to "ag" stripes: a quantized round's gather hop ships grid
+    # codes, dt = the grid's integer dtype, "qg" present — the version
+    # knob covers the semantics change; the schema is identical.)
     stripe_manifest_quant = ring.make_stripe_meta(
         stripe=1, n_stripes=4, nblocks=9, total_elems=1 << 21,
         dtype="uint8", phase="rs", qgrid_fp=12345,
+    )
+
+    # Hierarchy region manifest (the "hrm" sideband leaf of region
+    # reduce-scatter / partial-sum payloads, rayfed_tpu.fl.hierarchy):
+    # a cross-party contract layered on the ordinary payload manifest,
+    # with its own version knob (HIERARCHY_VERSION) — drift re-pins
+    # THIS lock without a WIRE_FORMAT_VERSION bump, like the ring
+    # stripe manifest.  The cross-region partial sums themselves ride
+    # as a RegionSumTree (an allowlisted QuantizedPackedTree subclass,
+    # ordinary payload framing — no new frame fields).
+    from rayfed_tpu.fl import hierarchy
+
+    region_manifest = hierarchy.make_region_meta(
+        "rs", region=1, n_regions=4, stripe=0, n_stripes=2, nblocks=9,
+        total_elems=1 << 21, dtype="uint8", qgrid_fp=12345,
+        members_fp=hierarchy.members_fingerprint(["a", "b"]), epoch=3,
     )
 
     # Shared quantization grid (compressed-domain aggregation,
@@ -193,6 +212,11 @@ def compute_fingerprint() -> str:
             "ring_stripe_schema": _schema(stripe_manifest),
             "ring_stripe_quant_schema": _schema(stripe_manifest_quant),
             "ring_stripe_version": ring.RING_STRIPE_VERSION,
+            # Hierarchical aggregation: the region manifest schema and
+            # its semantics version (region partition + partial-sum
+            # framing — fl.hierarchy).
+            "hierarchy_region_schema": _schema(region_manifest),
+            "hierarchy_version": hierarchy.HIERARCHY_VERSION,
             # Compressed-domain aggregation: the metadata key carrying
             # the round's shared quantization-grid descriptor, the
             # descriptor's schema, and the grid semantics version (the
