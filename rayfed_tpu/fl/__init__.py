@@ -11,6 +11,11 @@ Here the common algorithms ship with the framework:
   gradients pushed back (BASELINE.md config #5).
 - :mod:`fedopt` — server optimizers (FedAvgM/FedAdam/FedYogi) over the
   round's pseudo-gradient, and the FedProx client loss wrapper.
+- :mod:`server_opt` — the packed-domain rework of the server step:
+  FedAC / server momentum as fused finalize-side kernels over the
+  packed wire buffers, composing with ``wire_quant``/``quorum``/
+  ``mode="ring"/"hierarchy"`` and cutting ROUNDS-to-target, not just
+  round time (``run_fedavg_rounds(server_opt=fl.fedac(...))``).
 - :mod:`secagg` — secure aggregation: pairwise-masked integer folds
   (sum-only reveal) with HELLO-handshake key agreement and
   quorum-dropout mask recovery (``run_fedavg_rounds(secure_agg=True)``).
@@ -72,6 +77,14 @@ from rayfed_tpu.fl.fedopt import (
     server_sgd,
     server_yogi,
 )
+from rayfed_tpu.fl.server_opt import (
+    PackedServerOpt,
+    PackedServerOptimizer,
+    PackedServerState,
+    fedac,
+    server_momentum,
+)
+from rayfed_tpu.fl.trainer import validate_round_config
 from rayfed_tpu.fl.robust import (
     krum,
     multi_krum,
@@ -125,6 +138,12 @@ __all__ = [
     "server_adam",
     "server_yogi",
     "fedprox_loss",
+    "PackedServerOpt",
+    "PackedServerOptimizer",
+    "PackedServerState",
+    "fedac",
+    "server_momentum",
+    "validate_round_config",
     "mask_update",
     "unmask_sum",
     "MaskedCodeTree",

@@ -361,6 +361,138 @@ def finalize_packed_quantized(
       np.asarray(zps, np.float32), np.float32(total_w))
 
 
+@functools.lru_cache(maxsize=None)
+def server_step_kernel(kind: str, hyper: Tuple[float, ...]):
+    """ONE fused server-optimization step over packed f32 buffers —
+    the aggregate-then-step composition of :mod:`rayfed_tpu.fl.
+    server_opt`, placed beside :func:`finalize_packed_stripe` /
+    :func:`finalize_packed_quantized` because it consumes exactly their
+    output: ``step(x, avg, *state) -> x'`` where ``x`` is the round's
+    shared starting buffer, ``avg`` the finalized aggregate and
+    ``state`` the packed auxiliary sequence(s).  ``avg`` is deliberately
+    NOT donated: the streaming aggregator's result holder retains the
+    same buffer (and harnesses step several controller replicas over
+    one array) — the donated pass of the aggregate-then-step
+    composition is the fold accumulator upstream, and this kernel still
+    allocates exactly one output buffer.
+
+    Kinds (hyperparameters are static — one compile per config):
+
+    - ``"momentum"`` ``(lr, momentum)`` — FedAvgM on the packed buffer:
+      ``x' = x − lr·(momentum·m + (x − avg))``.  ``lr=1, momentum=0``
+      RETURNS ``avg`` literally (bit-exact plain FedAvg, not a
+      float-rounded reconstruction of it).
+    - ``"fedac"`` ``(lam, gamma, beta)`` — FedAC's linear-coupling
+      acceleration (Yuan & Ma 2020) with the round pseudo-gradient
+      ``Δ = x − avg``: conservative step ``y' = x − lam·Δ``, aggressive
+      step ``z' = z − gamma·Δ`` over the auxiliary sequence ``z``, and
+      the broadcast point ``x' = (1−beta)·y' + beta·z'``.  ``lam=1,
+      beta=0`` returns ``avg`` literally.
+
+    The step deliberately emits ONLY the new broadcast buffer: the
+    state advances via :func:`server_resync_kernel` from the broadcast
+    pair ``(x, x')`` on EVERY controller, which is what keeps the
+    replicated state byte-identical cluster-wide (see fl.server_opt).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if kind == "momentum":
+        lr, momentum = (float(h) for h in hyper)
+
+        @jax.jit
+        def _step(x, avg, m):
+            x = x.astype(jnp.float32)
+            avg = avg.astype(jnp.float32)
+            if momentum == 0.0 and lr == 1.0:
+                return avg  # plain FedAvg, bit-exactly
+            return x - lr * (momentum * m + (x - avg))
+
+        return _step
+    if kind == "fedac":
+        lam, gamma, beta = (float(h) for h in hyper)
+
+        @jax.jit
+        def _step(x, avg, z):
+            x = x.astype(jnp.float32)
+            avg = avg.astype(jnp.float32)
+            if beta == 0.0 and lam == 1.0:
+                return avg  # plain FedAvg, bit-exactly
+            delta = x - avg
+            y_new = x - lam * delta
+            z_new = z - gamma * delta
+            return (1.0 - beta) * y_new + beta * z_new
+
+        return _step
+    raise ValueError(
+        f"unknown server-opt kind {kind!r} — one of 'momentum', 'fedac'"
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def server_resync_kernel(kind: str, hyper: Tuple[float, ...]):
+    """Advance the packed server-opt state from the round's broadcast
+    pair: ``resync(x, x_new, *state) -> new state tuple``.
+
+    The companion of :func:`server_step_kernel`, and the reason every
+    controller's state replica stays BYTE-identical with zero extra
+    wire bytes: the state is defined as a deterministic f32 function of
+    ``(x, x_new, state)`` where ``x_new`` is the round's broadcast —
+    the one buffer the whole cluster already byte-agrees on (decoded
+    codes in quantized rounds, the f32 broadcast otherwise).  The
+    coordinator runs the SAME resync on the same decoded bytes instead
+    of keeping its exact-step state, so any downlink quantization error
+    is absorbed into the state consistently everywhere (the same
+    self-correction an EF residual performs, one level up).  State
+    buffers are deliberately NOT donated: FedAC's z₀ aliases the
+    caller's initial-point array, and the harnesses/tests retain state
+    references across rounds — an aliased donation frees a buffer
+    someone else still reads.
+
+    - ``"momentum"``: ``m' = (x − x_new)/lr`` (exactly the step the
+      broadcast realized).
+    - ``"fedac"``: ``z' = z − (gamma/D)·((1−beta)·x + beta·z − x_new)``
+      with ``D = (1−beta)·lam + beta·gamma`` — algebraically
+      ``z − gamma·Δ`` with ``Δ`` implied by the realized broadcast.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if kind == "momentum":
+        lr, _momentum = (float(h) for h in hyper)
+
+        # No donation: the old momentum buffer is replaced wholesale
+        # without being read, and XLA warns on donated-but-unused.
+        @jax.jit
+        def _resync(x, x_new, m):
+            del m  # replaced wholesale by the realized step
+            x = x.astype(jnp.float32)
+            x_new = x_new.astype(jnp.float32)
+            return ((x - x_new) / lr,)
+
+        return _resync
+    if kind == "fedac":
+        lam, gamma, beta = (float(h) for h in hyper)
+        denom = (1.0 - beta) * lam + beta * gamma
+
+        # No donation on z either: FedAC's z₀ aliases the caller's
+        # initial-point array (PackedServerOpt.init), and the state
+        # holder/test harnesses may retain references across rounds —
+        # one transient f32 buffer is not worth an aliasing hazard.
+        @jax.jit
+        def _resync(x, x_new, z):
+            x = x.astype(jnp.float32)
+            x_new = x_new.astype(jnp.float32)
+            return (
+                z - (gamma / denom) * ((1.0 - beta) * x + beta * z - x_new),
+            )
+
+        return _resync
+    raise ValueError(
+        f"unknown server-opt kind {kind!r} — one of 'momentum', 'fedac'"
+    )
+
+
 def packed_quantized_sum(
     quantized_trees: Sequence[Any],
     weights: Optional[Sequence[float]] = None,

@@ -16,6 +16,14 @@ identical aggregate, so no extra coordination is needed):
 
 Everything here is jit-compiled pytree arithmetic — one fused XLA op
 per leaf on device, the same shape as :func:`rayfed_tpu.fl.tree_average`.
+
+These are the LEGACY (unpacked-tree) optimizers: they run per-leaf on
+the driver's decompressed tree, which is why they are excluded from
+every packed-domain path (``wire_quant``, ``quorum``,
+``mode="hierarchy"``).  :mod:`rayfed_tpu.fl.server_opt` is the packed
+rework — server momentum and FedAC as fused finalize-side kernels over
+the packed wire buffers, composing with all of the above — and is what
+new code should reach for.
 """
 
 from __future__ import annotations

@@ -401,6 +401,7 @@ class HierarchyRound:
         quant_downlink: bool = False,
         dead: Sequence[str] = (),
         timings: Optional[Dict[str, float]] = None,
+        server_step: Optional[Any] = None,
     ) -> None:
         from rayfed_tpu.fl.fedavg import quant_weights
         from rayfed_tpu.fl.quantize import RoundCodec
@@ -445,6 +446,10 @@ class HierarchyRound:
         self._quant_scope = quant_scope
         self._quant_downlink = bool(quant_downlink)
         self._timings = timings
+        # Server optimization (fl.server_opt): the state steps ONCE, at
+        # the root, on the exact finalized f32 — the tree broadcast
+        # below then carries the post-step model to every level.
+        self._server_step = server_step
         contributors = [p for p in self._members if p not in self._dead]
         w_list = (
             None if weights is None
@@ -749,6 +754,16 @@ class HierarchyRound:
         _maybe_fault("down", me)
         down_descr = None
         if is_root:
+            if self._server_step is not None:
+                # The single server step of the round: exact finalized
+                # f32 in, post-step model out — the downlink recode's
+                # fresh grid is therefore ranged by the POST-step
+                # delta.  A failure here aborts through the standard
+                # poison cascade (every controller raises
+                # HierarchyRoundError and the driver falls back in
+                # lockstep, re-running the SAME step from the SAME
+                # state on the flat path).
+                result = self._server_step(result)
             wire_result = result
             if self._quant_downlink:
                 wire_result, result, down_descr = qz.quantize_downlink(
@@ -958,8 +973,15 @@ def hierarchy_aggregate(
     epoch: Optional[int] = None,
     timings: Optional[Dict[str, float]] = None,
     dead: Sequence[str] = (),
+    server_step: Optional[Any] = None,
 ) -> Any:
     """FedAvg round over the two-level hierarchy (see module docstring).
+
+    ``server_step`` (:mod:`rayfed_tpu.fl.server_opt`): applied ONCE, at
+    the root, to the exact finalized f32 aggregate; the tree broadcast
+    (and its ``quantize_downlink`` recode) carries the post-step model,
+    so every controller returns the stepped bytes — byte-identical to
+    the flat streaming/quorum paths applying the same step.
 
     Drop-in for ``streaming_aggregate``/``ring_aggregate`` when the
     contributions are PackedTrees with one contribution per party and
@@ -1040,6 +1062,7 @@ def hierarchy_aggregate(
         quant_downlink=quant_downlink,
         dead=dead,
         timings=timings,
+        server_step=server_step,
     )
     local_value = (
         objs[owners.index(me)].get_local_ref().resolve(timeout=backstop)

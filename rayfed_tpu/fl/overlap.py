@@ -49,6 +49,26 @@ identically) — either way a round is never silently skipped.
 ``run_fedavg_rounds(overlap=True)`` is the one-call entry point;
 :class:`PipelinedRoundRunner` is the engine underneath for callers that
 want to drive rounds themselves.
+
+Why ``server_opt`` stays a LOUD exclusion (fl.server_opt shipped the
+packed step for every *synchronous* topology): the DGA recurrence above
+is exactly FedAvg **because** the broadcast is the plain aggregate —
+``agg_k + (w_local − w_local_at_send)`` resyncs onto the mean and
+preserves local progress verbatim.  With a server step the broadcast is
+``x_{k+1} = step(x_k, agg_k)``; applying the correction to it would add
+one-round-stale RAW deltas on top of an already-stepped (momentum-
+scaled) model — the composed update is ``step(x, agg) + Δ`` where the
+synchronous recurrence wants ``step(x, agg + Δ/N)``-shaped terms, and
+the two only agree when the step is the identity.  Deriving the
+staleness-adjusted accelerated recurrence (the analogue of the
+quantized-DGA derivation, ROADMAP item 2b) is open work; until then the
+driver refuses the pair instead of silently training a different
+algorithm.  (The QUORUM loop's straggler late fold — the same
+``dga_correct`` call — is a different animal and composes deliberately:
+it is exceptional-path-only and bounded to one straggler-round of local
+work, which reaches the optimizer one round late inside the NEXT
+round's pseudo-gradient rather than recomposing every round's
+broadcast; see ``docs/source/server_optimization.rst``.)
 """
 
 from __future__ import annotations

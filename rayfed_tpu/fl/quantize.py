@@ -754,10 +754,14 @@ def quantize_downlink(
     whose range the 8-bit step actually resolves.  Returns ``(wire
     form, dequantized aggregate, grid descriptor)`` — the coordinator
     returns the DEQUANTIZED codes so every controller holds the
-    identical bytes.  ONE producer shared by ``streaming_aggregate``
-    and ``quorum_aggregate``: the quantized-quorum and quantized-
-    streaming downlinks are byte-identical by construction, not by
-    parallel maintenance.  ``scope`` keys the downlink's own
+    identical bytes.  ONE producer shared by ``streaming_aggregate``,
+    ``quorum_aggregate`` and the hierarchy root: the quantized-quorum,
+    quantized-streaming and hierarchical downlinks are byte-identical
+    by construction, not by parallel maintenance.  Under a server
+    optimizer (fl.server_opt) the caller steps BEFORE calling this, so
+    ``result`` is the post-step model and the fresh grid here is
+    automatically ranged by the post-step delta — no new metadata key,
+    no schema change.  ``scope`` keys the downlink's own
     error-feedback residual (``{scope}/down``); None quantizes
     statelessly.
     """

@@ -150,8 +150,17 @@ def quorum_aggregate(
     quant_ref: Optional[Any] = None,
     quant_scope: Optional[str] = None,
     secagg: Optional[Any] = None,
+    server_step: Optional[Any] = None,
 ) -> QuorumRoundOutcome:
     """One k-of-n streaming round over the coordinator topology.
+
+    ``server_step`` (:mod:`rayfed_tpu.fl.server_opt`): applied by the
+    coordinator to the exact finalized aggregate — AFTER the
+    deadline-gated cutoff's subset refold, so the step's pseudo-
+    gradient is the arrived members' reweighted mean (the subset Σw is
+    the effective divisor) — and BEFORE the result broadcast /
+    quantized downlink, which therefore carry the POST-step model.
+    Mutually exclusive with ``secagg`` (loud).
 
     ``updates``: ``{party: FedObject}`` for the round's active roster
     (sorted-party order defines the fold order).  Every active
@@ -220,6 +229,11 @@ def quorum_aggregate(
 
     masker = None
     if secagg is not None:
+        if server_step is not None:
+            raise QuorumRoundError(
+                "server_step does not compose with masked (secure_agg) "
+                "rounds yet — loud exclusion, see fl.server_opt"
+            )
         if quant is None:
             raise QuorumRoundError(
                 "secure aggregation requires the quantized domain "
@@ -356,6 +370,14 @@ def quorum_aggregate(
         # The fold grid IS the quantization grid.
         agg_kwargs["chunk_elems"] = quant.chunk_elems
         agg_kwargs["quant_ref"] = qref
+    elif server_step is not None:
+        # The server step consumes the exact f32 aggregate (re-casting
+        # the mean to the wire dtype first would be exactly the loss no
+        # residual compensates); quantized rounds finalize in f32
+        # already.
+        import numpy as _np
+
+        agg_kwargs["out_dtype"] = _np.float32
     if masker is not None:
         def _mask_recovery(member_labels):
             # Runs on the aggregator worker between the cutoff (member
@@ -460,6 +482,13 @@ def quorum_aggregate(
     try:
         result = agg.result(timeout=backstop, deadline_s=deadline_s)
         members = [parties[i] for i in agg.quorum_members]
+        if server_step is not None:
+            # Post-cutoff, pre-broadcast: the step's pseudo-gradient is
+            # the arrived subset's reweighted mean, and the broadcast /
+            # quantized downlink below carry the POST-step model.
+            # Inside the poison-protected block: a step failure must
+            # reach the parked peers like any coordinator-side failure.
+            result = server_step(result)
         # Excluded stragglers' sinks must not linger: an armed sink
         # keeps the health monitor probing its source forever, and a
         # very late payload would park unread.  Cancelled sinks drop
@@ -635,6 +664,7 @@ def run_quorum_rounds(
     wire_quant: Optional[str] = None,
     secure_agg: bool = False,
     region_size: Optional[int] = None,
+    server_opt: Optional[Any] = None,
 ) -> Any:
     """The quorum-mode round loop behind ``run_fedavg_rounds(quorum=k)``.
 
@@ -746,6 +776,40 @@ def run_quorum_rounds(
                 "exclusive — pairwise masks only cancel over the full "
                 "party set (fl.hierarchy)"
             )
+    sopt = None
+    sopt_descr = None
+    if server_opt is not None:
+        from rayfed_tpu.fl.server_opt import (
+            PackedServerOpt,
+            PackedServerOptimizer,
+        )
+
+        if not isinstance(server_opt, PackedServerOpt):
+            raise QuorumRoundError(
+                "quorum rounds take a fl.server_opt.PackedServerOpt "
+                "(the packed-domain server optimizer, e.g. fl.server_opt"
+                ".fedac(...)); legacy fedopt.ServerOptimizer optimizers "
+                "run per-leaf tree arithmetic and need the exact "
+                "fixed-roster classic loop"
+            )
+        if secure_agg:
+            raise QuorumRoundError(
+                "server_opt does not compose with secure_agg yet — the "
+                "masked recovery window has not been exercised with a "
+                "post-finalize step (loud exclusion, fl.server_opt)"
+            )
+        if join_ticket is not None:
+            raise QuorumRoundError(
+                "a join_ticket cannot enter a server_opt run: the "
+                "welcome does not carry the server-optimizer state, so "
+                "the joiner's replica would silently reset the "
+                "trajectory on its first coordinator lease (loud "
+                "exclusion, fl.server_opt)"
+            )
+        sopt = PackedServerOptimizer(server_opt)
+    from rayfed_tpu.fl.server_opt import describe_server_opt
+
+    sopt_descr = describe_server_opt(server_opt)
     secagg_keys = None
     if secure_agg:
         if wire_quant is None:
@@ -801,7 +865,10 @@ def run_quorum_rounds(
 
     restored = None
     if checkpointer is not None and join_ticket is None:
-        restored = _restore_quorum_snapshot(checkpointer, params, roster, log)
+        restored = _restore_quorum_snapshot(
+            checkpointer, params, roster, log, sopt=sopt,
+            sopt_descr=sopt_descr,
+        )
 
     # Compressed-domain state: the previous round's observed aggregate
     # delta (derived from broadcast values only — bit-identical on every
@@ -931,6 +998,21 @@ def run_quorum_rounds(
                         else None
                     ),
                 )
+        # Server optimization (fl.server_opt): the round's shared
+        # starting buffer anchors both the step (at the finalizing
+        # node) and the post-round state resync (on EVERY controller) —
+        # it is the broadcast every party already byte-agrees on.
+        step_fn = None
+        x_srv = None
+        if sopt is not None:
+            x_srv = (
+                round_ref if round_ref is not None
+                else np.asarray(current.buf).astype(
+                    np.float32
+                ).reshape(-1)
+            )
+            sopt.ensure(x_srv)
+            step_fn = sopt.step_fn(x_srv)
         rec = None
         if timings is not None:
             rec = {"local_s": 0.0, "push_s": 0.0, "agg_s": 0.0,
@@ -984,6 +1066,7 @@ def run_quorum_rounds(
                     secagg=(
                         secagg_keys if round_grid is not None else None
                     ),
+                    server_step=step_fn,
                 )
                 break
             except QuorumRoundError as exc:
@@ -1015,7 +1098,17 @@ def run_quorum_rounds(
         avg, members = outcome.result, outcome.members
         # Stragglers fold their missed round-r progress into round r+1
         # (DGA recurrence) instead of dropping it — each correction is a
-        # party-local fed task, no extra wire traffic.
+        # party-local fed task, no extra wire traffic.  Under server_opt
+        # the broadcast is the POST-step model, so the straggler's
+        # preserved delta rides into its NEXT contribution and reaches
+        # the optimizer one round late as part of that round's
+        # pseudo-gradient, scaled by the step like any fresh signal.
+        # This is the deliberate, bounded (one straggler-round of local
+        # work, exceptional-path-only) generalization of "late fold,
+        # not drop" — documented in server_optimization.rst; contrast
+        # overlap=True, which stays excluded because there EVERY party
+        # EVERY round would compose stale raw deltas with the stepped
+        # broadcast, changing the recurrence systematically.
         for p in active:
             if p not in members:
                 late_inputs[p] = dga.party(p).remote(
@@ -1043,10 +1136,21 @@ def run_quorum_rounds(
             "members": list(members), "coordinator": coord,
         })
         current = avg
+        if sopt is not None:
+            # Every controller advances its state replica from the
+            # round's byte-agreed broadcast pair — the broadcast IS the
+            # post-step model (the coordinator/root stepped before the
+            # downlink), so all replicas stay byte-identical and any
+            # successor can coordinate the next round with the right
+            # state in hand.  A failed attempt never reaches here: the
+            # failover re-runs the SAME step from the SAME state.
+            sopt.resync(x_srv, np.asarray(avg.buf))
         if wire_quant is not None:
             # Next round's grid range: how far the global model just
             # moved, per block — derived from broadcast values only,
-            # so bit-identical on every controller.
+            # so bit-identical on every controller.  Under server_opt
+            # the broadcast is the POST-step model, so the next
+            # round's uplink grid is ranged by the post-step delta.
             quant_prev_delta = (
                 np.asarray(avg.buf).astype(np.float32).reshape(-1)
                 - round_ref
@@ -1069,14 +1173,21 @@ def run_quorum_rounds(
             (r + 1) % checkpoint_every == 0
         ):
             ep_now, mem_now = roster.snapshot()
+            snap = {"params": decompress(current)}
+            if sopt is not None:
+                # The server-opt state rides the snapshot; its spec
+                # stamp below is what makes a cross-config restore
+                # refuse loudly instead of silently resetting momentum.
+                snap["server_state"] = sopt.state
             checkpointer.save(
-                r + 1, {"params": decompress(current)},
+                r + 1, snap,
                 metadata={
                     "quorum_session": session,
                     "epoch": int(ep_now),
                     "members": list(mem_now),
                     "coordinator": coord,
                     "member_log": [dict(e) for e in log],
+                    "server_opt": sopt_descr,
                 },
             )
         r += 1
@@ -1099,7 +1210,7 @@ def _aggregate_with_mode(
     runtime, updates, w_map, *, session, round_index, quorum, deadline_s,
     coordinator, stream, epoch, mode, ring_chunk_elems, announce_fn,
     backstop, active, timings, quant=None, quant_ref=None,
-    quant_scope=None, secagg=None, region_size=None,
+    quant_scope=None, secagg=None, region_size=None, server_step=None,
 ) -> QuorumRoundOutcome:
     """Topology-first aggregation when ``mode`` is ``"ring"`` or
     ``"hierarchy"``: a straggler or dead party aborts the topology
@@ -1185,6 +1296,11 @@ def _aggregate_with_mode(
                 None if w_map is None
                 else [w_map[p] for p in sorted(updates)],
                 stream=f"{stream}/ring",
+                # The server step consumes the exact f32 assembly (see
+                # below); plain rounds keep the wire dtype.
+                out_dtype=(
+                    "float32" if server_step is not None else None
+                ),
                 chunk_elems=ring_chunk_elems,
                 seq_ids=(f"{down}.rs", f"{down}.ag", f"{down}.c",
                          f"{down}.rl", f"{down}.nm"),
@@ -1193,6 +1309,12 @@ def _aggregate_with_mode(
                 expect_parties=active,
                 timings=timings,
             )
+            if server_step is not None:
+                # The ring has no downlink — every controller already
+                # holds the byte-identical assembled aggregate, so each
+                # applies the SAME deterministic f32 step locally and
+                # all byte-agree on the post-step model (fl.server_opt).
+                result = server_step(result)
             return _announce_after_topology(result)
         except RingRoundError as exc:
             logger.warning(
@@ -1231,6 +1353,7 @@ def _aggregate_with_mode(
                     deadline_s if deadline_s is not None else backstop
                 ),
                 timings=timings,
+                server_step=server_step,
             )
             return _announce_after_topology(result)
         except HierarchyRoundError as exc:
@@ -1252,32 +1375,54 @@ def _aggregate_with_mode(
         stream=stream, epoch=epoch, announce_fn=announce_fn,
         backstop=backstop, timings=timings, quant=quant,
         quant_ref=quant_ref, quant_scope=quant_scope, secagg=secagg,
+        server_step=server_step,
     )
 
 
-def _restore_quorum_snapshot(checkpointer, params, roster, log):
+def _restore_quorum_snapshot(checkpointer, params, roster, log,
+                             sopt=None, sopt_descr=None):
     """Resume a quorum run from its latest snapshot: returns
     ``(start_round, session, params)`` — with the roster epoch/members
-    applied and the member log replayed into ``log`` — or ``None`` when
-    the checkpointer holds nothing yet.  The caller re-derives the
-    coordinator from the restored roster."""
+    applied, the member log replayed into ``log`` and the server-opt
+    state (when the run carries one) loaded into ``sopt`` — or ``None``
+    when the checkpointer holds nothing yet.  The caller re-derives the
+    coordinator from the restored roster.  The snapshot's ``server_opt``
+    stamp must match ``sopt_descr`` (loud refusal either direction —
+    fl.server_opt.check_snapshot_server_opt)."""
     latest = checkpointer.latest_round()
     if latest is None:
         return None
-    from rayfed_tpu.fl.compression import PackedTree, decompress
+    from rayfed_tpu.fl.compression import PackedTree, decompress, pack_tree
 
     tmpl = decompress(params) if isinstance(params, PackedTree) else params
-    restored_round, snap = checkpointer.restore(target={"params": tmpl})
     # "ckpt_meta", not "meta": checkpoint metadata lives on local disk —
     # it is NOT frame metadata, whose literal keys fedlint FED006 polices.
-    ckpt_meta = checkpointer.load_metadata(restored_round)
+    ckpt_meta = checkpointer.load_metadata(latest)
     if "quorum_session" not in ckpt_meta:
         raise QuorumRoundError(
-            f"checkpoint round {restored_round} was not written by a "
+            f"checkpoint round {latest} was not written by a "
             f"quorum run (no roster epoch / rendezvous session in its "
             f"metadata) — a classic-loop checkpoint directory cannot "
             f"resume a quorum run"
         )
+    if sopt_descr is not None:
+        from rayfed_tpu.fl.server_opt import check_snapshot_server_opt
+
+        check_snapshot_server_opt(
+            ckpt_meta.get("server_opt"), sopt_descr
+        )
+    target = {"params": tmpl}
+    if sopt is not None:
+        import jax.numpy as _jnp
+
+        target["server_state"] = sopt.opt.init(
+            pack_tree(tmpl, _jnp.float32).buf
+        )
+    restored_round, snap = checkpointer.restore(
+        round_num=latest, target=target
+    )
+    if sopt is not None:
+        sopt.load_state(snap["server_state"])
     roster.apply(int(ckpt_meta["epoch"]), list(ckpt_meta["members"]))
     del log[:]
     log.extend(dict(e) for e in (ckpt_meta.get("member_log") or []))

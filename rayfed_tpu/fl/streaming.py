@@ -1443,6 +1443,7 @@ def streaming_aggregate(
     quant_scope: Optional[str] = None,
     quant_downlink: bool = False,
     secagg: Optional[Any] = None,
+    server_step: Optional[Any] = None,
 ) -> Any:
     """FedAvg round over the streaming + delta-cache pipeline.
 
@@ -1494,6 +1495,16 @@ def streaming_aggregate(
     downlink bytes drop too; every party — coordinator included —
     returns the identical dequantized tree.
 
+    ``server_step`` (:mod:`rayfed_tpu.fl.server_opt`): a finalize-side
+    hook the COORDINATOR applies to the exact finalized aggregate
+    before the result broadcast — the broadcast (and, with
+    ``quant_downlink``, the re-quantized downlink, whose fresh grid is
+    therefore ranged by the POST-step delta) carries the post-step
+    model, so every controller returns the stepped bytes and advances
+    its replicated optimizer state from them.  A step failure aborts
+    the round on every controller (peers' parked broadcast is
+    poisoned) — never a silent pre-step broadcast.
+
     Multi-host parties: only the party LEADER process runs the
     cross-party wire, so streaming aggregation works on the leader and
     raises ``NotImplementedError`` on non-leader coordinator processes
@@ -1523,6 +1534,13 @@ def streaming_aggregate(
         raise ValueError(
             "secagg= requires quant= — masks live in the shared-grid "
             "integer domain (fl.secagg)"
+        )
+    if server_step is not None and secagg is not None:
+        raise ValueError(
+            "server_step does not compose with masked (secure_agg) "
+            "rounds yet — the recovery window has not been exercised "
+            "with a post-finalize step (loud exclusion, see "
+            "fl.server_opt)"
         )
     # The sender-side codec discipline (grid check + EF two-phase
     # commit), shared verbatim with ring/quorum; a no-op when quant is
@@ -1666,6 +1684,12 @@ def streaming_aggregate(
     others = [p for p in parties if p != me]
     try:
         result = agg.result(timeout=backstop)
+        if server_step is not None:
+            # The server-optimization step consumes the EXACT finalized
+            # f32 aggregate (fl.server_opt); inside the try so a step
+            # failure poisons the peers' parked broadcast like any
+            # other coordinator-side failure.
+            result = server_step(result)
     except BaseException as exc:
         _quant_rollback()
         for up, down in pending_cancels:

@@ -51,6 +51,394 @@ def sample_parties(
     return sorted(rng.sample(sorted(parties), int(sample)))
 
 
+def validate_round_config(
+    trainers: dict,
+    *,
+    rounds: int = 1,
+    server_opt: Optional[Any] = None,
+    weights: Optional[Sequence[float]] = None,
+    compress_wire: bool = False,
+    packed_wire: bool = False,
+    checkpointer: Any = None,
+    checkpoint_every: int = 0,
+    sample: Optional[int] = None,
+    aggregator: Optional[Callable[[Sequence[Any]], Any]] = None,
+    streaming_agg: bool = False,
+    error_feedback: bool = False,
+    wire_quant: Optional[Any] = None,
+    mode: str = "coordinator",
+    coordinator: Optional[str] = None,
+    overlap: bool = False,
+    ring_chunk_elems: Optional[int] = None,
+    region_size: Optional[int] = None,
+    quorum: Optional[int] = None,
+    round_deadline_s: Optional[float] = None,
+    join_ticket: Optional[dict] = None,
+    round_log: Optional[list] = None,
+    secure_agg: bool = False,
+) -> dict:
+    """Validate one round-loop configuration WITHOUT running it.
+
+    The single producer of every feature-composition verdict
+    :func:`run_fedavg_rounds` enforces: each feature pair either
+    passes here (and is exercised bit-exactly by a test or bench gate)
+    or raises a LOUD ``ValueError`` naming the clash — never a silent
+    fallback.  Extracted so the composition-matrix test
+    (``tests/test_composition_matrix.py``) can drive the full pairwise
+    grid in-process, with no runtime or party subprocesses.
+
+    Returns the normalized bits the driver needs downstream:
+    ``{"wire_quant": <dtype name or None>, "checkpoint_every": <int>,
+    "server_opt_kind": "none"|"fedopt"|"packed"}``.
+    """
+    from rayfed_tpu.fl.server_opt import PackedServerOpt
+
+    packed_opt = (
+        server_opt if isinstance(server_opt, PackedServerOpt) else None
+    )
+    legacy_opt = (
+        server_opt
+        if (server_opt is not None and packed_opt is None)
+        else None
+    )
+    if legacy_opt is not None and not isinstance(
+        legacy_opt, ServerOptimizer
+    ):
+        raise ValueError(
+            f"server_opt must be a fl.server_opt.PackedServerOpt "
+            f"(packed-domain momentum/FedAC — composes with "
+            f"wire_quant/quorum/ring/hierarchy) or a legacy "
+            f"fl.fedopt.ServerOptimizer, got "
+            f"{type(server_opt).__name__}"
+        )
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    if checkpoint_every and checkpointer is None:
+        raise ValueError("checkpoint_every set without a checkpointer")
+    if checkpoint_every < 0:
+        raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+    if checkpointer is not None and not checkpoint_every:
+        # A checkpointer with checkpoint_every=0 would resume but never
+        # save — snapshot every round rather than silently never.
+        checkpoint_every = 1
+    if aggregator is not None and weights is not None:
+        raise ValueError(
+            "aggregator and weights are mutually exclusive (a custom "
+            "reducer defines its own weighting)"
+        )
+    if sample is not None and not 1 <= int(sample) <= len(trainers):
+        raise ValueError(
+            f"sample must be in [1, {len(trainers)}], got {sample}"
+        )
+    if sample is not None and weights is not None:
+        raise ValueError(
+            "sample and weights are mutually exclusive (a weight "
+            "sequence cannot align with a changing per-round subset)"
+        )
+    _qname = None
+    if wire_quant is not None:
+        import numpy as _np
+
+        _qname = _np.dtype(wire_quant).name
+        if _qname not in ("uint8", "int8"):
+            raise ValueError(
+                f"wire_quant must be an 8-bit integer dtype (uint8/"
+                f"int8), got {_qname!r}"
+            )
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "wire_quant requires compress_wire=True and "
+                "packed_wire=True (the quantized unit is the packed "
+                "wire buffer)"
+            )
+        if (
+            not streaming_agg
+            and mode not in ("ring", "hierarchy")
+            and quorum is None
+        ):
+            raise ValueError(
+                "wire_quant requires streaming_agg=True, mode='ring', "
+                "mode='hierarchy' or quorum= — the compressed-domain "
+                "fold lives in the streaming/striped aggregators "
+                "(fl.quantize)"
+            )
+        if quorum is not None and mode == "ring":
+            raise ValueError(
+                "wire_quant + quorum runs the coordinator topology — "
+                "mode='ring' is a loud exclusion there (the quorum "
+                "ring has not been taught the quantized stripe shape)"
+            )
+        incompat_q = {
+            "error_feedback": error_feedback,  # quant carries its OWN
+            "aggregator": aggregator is not None,
+            # PACKED server optimizers (fl.server_opt) compose: the
+            # step runs on the exact finalized f32 beside the single
+            # rescale.  Only the legacy per-leaf tree optimizers are
+            # excluded here.
+            "server_opt": legacy_opt is not None,
+            "overlap": overlap,
+        }
+        bad_q = [k for k, v in incompat_q.items() if v]
+        if bad_q:
+            raise ValueError(
+                f"wire_quant is incompatible with {bad_q}: the "
+                f"grid codec carries its own error feedback, the "
+                f"other paths have not been taught the quantized round "
+                f"shape, and a legacy fedopt.ServerOptimizer runs "
+                f"per-leaf tree arithmetic — use the packed "
+                f"fl.server_opt optimizers with wire_quant"
+            )
+    if secure_agg:
+        if wire_quant is None:
+            raise ValueError(
+                "secure_agg requires wire_quant — pairwise masks live "
+                "in the shared-grid integer domain (fl.secagg); pass "
+                "e.g. wire_quant='uint8'"
+            )
+        if mode == "ring":
+            raise ValueError(
+                "secure_agg runs the streaming/quorum coordinator "
+                "topology — mode='ring' is a loud exclusion (stripe "
+                "owners would each see a maskable subset)"
+            )
+        if sample is not None and sample != len(trainers):
+            raise ValueError(
+                "secure_agg and sample are mutually exclusive: the "
+                "mask peer set is the round's full active roster"
+            )
+    if streaming_agg and not (compress_wire and packed_wire):
+        raise ValueError(
+            "streaming_agg requires compress_wire=True and "
+            "packed_wire=True (the streamed unit is the packed wire "
+            "buffer)"
+        )
+    if streaming_agg and aggregator is not None:
+        raise ValueError(
+            "streaming_agg and aggregator are mutually exclusive (a "
+            "custom reducer needs the raw per-party values)"
+        )
+    if error_feedback and not (compress_wire and packed_wire):
+        raise ValueError(
+            "error_feedback requires compress_wire=True and "
+            "packed_wire=True (the residual is carried on the packed "
+            "wire buffer)"
+        )
+    if mode not in ("coordinator", "ring", "hierarchy"):
+        raise ValueError(
+            f"unknown mode {mode!r}: expected 'coordinator', 'ring' or "
+            f"'hierarchy'"
+        )
+    if mode == "hierarchy":
+        if wire_quant is None:
+            raise ValueError(
+                "mode='hierarchy' requires wire_quant: hierarchical "
+                "aggregation is compressed-domain ONLY (float partial "
+                "sums would re-associate a non-associative fold and "
+                "silently break hierarchical == flat byte-identity) — "
+                "pass e.g. wire_quant='uint8'"
+            )
+        if region_size is None or int(region_size) < 1:
+            raise ValueError(
+                "mode='hierarchy' requires region_size= (the "
+                "deterministic partition width of the sorted roster), "
+                f"got {region_size!r}"
+            )
+        if streaming_agg:
+            raise ValueError(
+                "mode='hierarchy' and streaming_agg are mutually "
+                "exclusive: the hierarchy replaces the flat hub "
+                "topology streaming_agg folds on (its fallback path "
+                "streams on its own) — drop streaming_agg"
+            )
+        if sample is not None and sample != len(trainers):
+            raise ValueError(
+                "mode='hierarchy' requires full participation: "
+                "sampling churns the region partition every round, "
+                "re-striping every region ring — use "
+                "mode='coordinator' for sampled rounds"
+            )
+        if secure_agg:
+            raise ValueError(
+                "mode='hierarchy' and secure_agg are mutually "
+                "exclusive: pairwise masks only cancel over the FULL "
+                "party set, so a region's partial sum would be "
+                "un-finalizable ring noise — loud exclusion, never "
+                "silent garbage"
+            )
+        if aggregator is not None:
+            raise ValueError(
+                "mode='hierarchy' and aggregator are mutually "
+                "exclusive (a custom reducer needs the raw per-party "
+                "values at one place)"
+            )
+    if region_size is not None and mode != "hierarchy":
+        raise ValueError(
+            "region_size only applies to mode='hierarchy' (it sets "
+            "the deterministic region partition width)"
+        )
+    if mode == "ring":
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "mode='ring' requires compress_wire=True and "
+                "packed_wire=True (the striped unit is the packed wire "
+                "buffer)"
+            )
+        if aggregator is not None:
+            raise ValueError(
+                "mode='ring' and aggregator are mutually exclusive (a "
+                "custom reducer needs the raw per-party values at one "
+                "place)"
+            )
+        if sample is not None and sample != len(trainers):
+            raise ValueError(
+                "mode='ring' requires full participation: sampling "
+                "churns ring membership, re-striping the chunk grid "
+                "and thrashing the per-peer delta caches every round — "
+                "use mode='coordinator' for sampled rounds"
+            )
+        if streaming_agg:
+            raise ValueError(
+                "mode='ring' and streaming_agg are mutually exclusive: "
+                "the ring replaces the hub topology streaming_agg "
+                "folds on (the ring's fallback path streams on its "
+                "own) — drop streaming_agg or use mode='coordinator'"
+            )
+    if coordinator is not None and coordinator not in trainers:
+        raise ValueError(
+            f"coordinator {coordinator!r} is not a training party "
+            f"({sorted(trainers)})"
+        )
+    if ring_chunk_elems is not None and mode not in ("ring", "hierarchy"):
+        raise ValueError(
+            "ring_chunk_elems only applies to mode='ring' or "
+            "mode='hierarchy' (it sets the stripe/chunk grid "
+            "granularity)"
+        )
+    if quorum is not None:
+        if not 1 <= int(quorum) <= len(trainers):
+            raise ValueError(
+                f"quorum must be in [1, {len(trainers)}], got {quorum}"
+            )
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "quorum requires compress_wire=True and packed_wire=True "
+                "(the quorum cutoff and the DGA late fold run on the "
+                "packed wire buffer)"
+            )
+        incompat = {
+            # Packed server optimizers compose with quorum (the
+            # cutoff's subset refold reweights the step's effective
+            # Σw, and the replicated state survives coordinator
+            # failover) — only the legacy tree optimizers need the
+            # fixed-roster classic loop.
+            "server_opt": legacy_opt is not None,
+            "aggregator": aggregator is not None,
+            "sample": sample is not None and sample != len(trainers),
+            "error_feedback": error_feedback,
+            "overlap": overlap,
+        }
+        bad = [k for k, v in incompat.items() if v]
+        if bad:
+            raise ValueError(
+                f"quorum is incompatible with {bad}: each needs the "
+                "exact fixed-roster synchronous round boundary that "
+                "k-of-n cutoffs and elastic membership give up (packed "
+                "fl.server_opt optimizers DO compose with quorum)"
+            )
+    if round_deadline_s is not None:
+        if quorum is None:
+            raise ValueError(
+                "round_deadline_s only applies with quorum= (it is the "
+                "straggler cutoff of k-of-n rounds)"
+            )
+        if not round_deadline_s > 0:
+            raise ValueError(
+                f"round_deadline_s must be > 0, got {round_deadline_s}"
+            )
+    if join_ticket is not None and quorum is None:
+        raise ValueError(
+            "join_ticket only applies with quorum= (elastic membership "
+            "rides the quorum round protocol)"
+        )
+    if round_log is not None and quorum is None:
+        raise ValueError(
+            "round_log only applies with quorum= (the classic loop has "
+            "a fixed roster — there is nothing to log)"
+        )
+    if overlap:
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "overlap=True requires compress_wire=True and "
+                "packed_wire=True (the overlapped aggregation unit is "
+                "the packed wire buffer, and the DGA correction runs on "
+                "it)"
+            )
+        incompat = {
+            "server_opt": server_opt is not None,
+            "aggregator": aggregator is not None,
+            "sample": sample is not None and sample != len(trainers),
+            "error_feedback": error_feedback,
+            "checkpointer": checkpointer is not None,
+        }
+        bad = [k for k, v in incompat.items() if v]
+        if bad:
+            raise ValueError(
+                f"overlap=True is incompatible with {bad}: each needs "
+                "the exact synchronous round boundary (the overlapped "
+                "aggregate lands one round late, under the next round's "
+                "compute)"
+            )
+
+
+    if packed_opt is not None:
+        if not (compress_wire and packed_wire):
+            raise ValueError(
+                "a packed server_opt (fl.server_opt) requires "
+                "compress_wire=True and packed_wire=True — the fused "
+                "step runs over the packed wire buffer"
+            )
+        incompat_s = {
+            # The outgoing-wire EF residual corrects the model the
+            # DRIVER pushes; under a server step the broadcast already
+            # IS the stepped model — pair aggressive wire dtypes with
+            # wire_quant (whose grid codec carries its own EF) instead.
+            "error_feedback": error_feedback,
+            # A custom reducer's output is not the weighted mean the
+            # pseudo-gradient step assumes (and need not be packed).
+            "aggregator": aggregator is not None,
+            # The masked recovery window has not been exercised with a
+            # post-finalize step — loud exclusion, never silently
+            # unstepped or unmasked.
+            "secure_agg": secure_agg,
+            # A changing per-round subset is fine for the MEAN but the
+            # legacy tree path is the one with sampling history; the
+            # packed step has no sampled-round test yet.
+            "sample": sample is not None and sample != len(trainers),
+            # The welcome does not carry server-opt state; a joiner
+            # would silently reset the trajectory on its first
+            # coordinator lease.
+            "join_ticket": join_ticket is not None,
+        }
+        bad_s = [k for k, v in incompat_s.items() if v]
+        if bad_s:
+            raise ValueError(
+                f"packed server_opt is incompatible with {bad_s} — "
+                f"loud exclusion (see fl.server_opt's composition "
+                f"notes); overlap=True is excluded separately because "
+                f"the DGA correction assumes the broadcast IS the "
+                f"aggregate"
+            )
+    return {
+        "wire_quant": _qname if wire_quant is not None else None,
+        "checkpoint_every": checkpoint_every,
+        "server_opt_kind": (
+            "none" if server_opt is None
+            else "packed" if packed_opt is not None
+            else "fedopt"
+        ),
+    }
+
+
 def run_fedavg_rounds(
     trainers: dict,
     params: Any,
@@ -88,8 +476,27 @@ def run_fedavg_rounds(
     returns the party's updated tree (each party's actor runs only on
     its own silo).  Every controller passes the identical arguments.
 
-    - ``server_opt``: apply a :mod:`rayfed_tpu.fl.fedopt` optimizer to
-      the round aggregate (plain replacement when ``None``).
+    - ``server_opt``: apply a server optimizer to the round aggregate
+      (plain replacement when ``None``).  A
+      :class:`rayfed_tpu.fl.server_opt.PackedServerOpt` (``fl.fedac(λ,
+      γ, β)`` / ``fl.server_momentum(lr, momentum)``) runs as ONE
+      fused kernel over the packed wire buffers at the single
+      finalize, cutting ROUNDS-to-target (FedAC), and composes with
+      ``wire_quant``, ``streaming_agg``, ``quorum`` (the cutoff's
+      subset refold reweights the step's effective Σw; the replicated
+      state survives coordinator failover), ``mode="ring"`` (every
+      controller steps the byte-identical assembly locally) and
+      ``mode="hierarchy"`` (the root steps once; the tree broadcast
+      carries the post-step model); requires ``compress_wire`` +
+      ``packed_wire``; loudly excluded with ``overlap``/``secure_agg``/
+      ``error_feedback``/``aggregator``/``sample``/``join_ticket`` —
+      see :mod:`rayfed_tpu.fl.server_opt` and
+      ``docs/source/server_optimization.rst``.  A legacy
+      :mod:`rayfed_tpu.fl.fedopt` ``ServerOptimizer`` keeps the
+      per-leaf tree path (coordinator/ring topologies, no
+      wire_quant/quorum).  Checkpoints stamp the server-opt config and
+      carry its state; restoring across differing configs is refused
+      loudly.
     - ``compress_wire``: halves the push bytes.  Trainer contract:
       ``train`` must call :func:`~rayfed_tpu.fl.decompress` on its
       argument (a no-op on full-precision input) and return
@@ -284,274 +691,58 @@ def run_fedavg_rounds(
 
     Returns the final global params (identical on every controller).
     """
-    if rounds < 1:
-        raise ValueError(f"rounds must be >= 1, got {rounds}")
-    if checkpoint_every and checkpointer is None:
-        raise ValueError("checkpoint_every set without a checkpointer")
-    if checkpoint_every < 0:
-        raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
-    if checkpointer is not None and not checkpoint_every:
-        # A checkpointer with checkpoint_every=0 would resume but never
-        # save — snapshot every round rather than silently never.
-        checkpoint_every = 1
-    if aggregator is not None and weights is not None:
-        raise ValueError(
-            "aggregator and weights are mutually exclusive (a custom "
-            "reducer defines its own weighting)"
-        )
-    if sample is not None and not 1 <= int(sample) <= len(trainers):
-        raise ValueError(
-            f"sample must be in [1, {len(trainers)}], got {sample}"
-        )
-    if sample is not None and weights is not None:
-        raise ValueError(
-            "sample and weights are mutually exclusive (a weight "
-            "sequence cannot align with a changing per-round subset)"
-        )
-    if wire_quant is not None:
-        import numpy as _np
+    cfg = validate_round_config(
+        trainers,
+        rounds=rounds,
+        server_opt=server_opt,
+        weights=weights,
+        compress_wire=compress_wire,
+        packed_wire=packed_wire,
+        checkpointer=checkpointer,
+        checkpoint_every=checkpoint_every,
+        sample=sample,
+        aggregator=aggregator,
+        streaming_agg=streaming_agg,
+        error_feedback=error_feedback,
+        wire_quant=wire_quant,
+        mode=mode,
+        coordinator=coordinator,
+        overlap=overlap,
+        ring_chunk_elems=ring_chunk_elems,
+        region_size=region_size,
+        quorum=quorum,
+        round_deadline_s=round_deadline_s,
+        join_ticket=join_ticket,
+        round_log=round_log,
+        secure_agg=secure_agg,
+    )
+    checkpoint_every = cfg["checkpoint_every"]
+    _qname = cfg["wire_quant"]
+    import numpy as _np
 
-        _qname = _np.dtype(wire_quant).name
-        if _qname not in ("uint8", "int8"):
-            raise ValueError(
-                f"wire_quant must be an 8-bit integer dtype (uint8/"
-                f"int8), got {_qname!r}"
-            )
-        if not (compress_wire and packed_wire):
-            raise ValueError(
-                "wire_quant requires compress_wire=True and "
-                "packed_wire=True (the quantized unit is the packed "
-                "wire buffer)"
-            )
-        if (
-            not streaming_agg
-            and mode not in ("ring", "hierarchy")
-            and quorum is None
-        ):
-            raise ValueError(
-                "wire_quant requires streaming_agg=True, mode='ring', "
-                "mode='hierarchy' or quorum= — the compressed-domain "
-                "fold lives in the streaming/striped aggregators "
-                "(fl.quantize)"
-            )
-        if quorum is not None and mode == "ring":
-            raise ValueError(
-                "wire_quant + quorum runs the coordinator topology — "
-                "mode='ring' is a loud exclusion there (the quorum "
-                "ring has not been taught the quantized stripe shape)"
-            )
-        incompat_q = {
-            "error_feedback": error_feedback,  # quant carries its OWN
-            "aggregator": aggregator is not None,
-            "server_opt": server_opt is not None,
-            "overlap": overlap,
-        }
-        bad_q = [k for k, v in incompat_q.items() if v]
-        if bad_q:
-            raise ValueError(
-                f"wire_quant is incompatible with {bad_q}: the "
-                f"grid codec carries its own error feedback, and the "
-                f"other paths have not been taught the quantized round "
-                f"shape"
-            )
-    if secure_agg:
-        if wire_quant is None:
-            raise ValueError(
-                "secure_agg requires wire_quant — pairwise masks live "
-                "in the shared-grid integer domain (fl.secagg); pass "
-                "e.g. wire_quant='uint8'"
-            )
-        if mode == "ring":
-            raise ValueError(
-                "secure_agg runs the streaming/quorum coordinator "
-                "topology — mode='ring' is a loud exclusion (stripe "
-                "owners would each see a maskable subset)"
-            )
-        if sample is not None and sample != len(trainers):
-            raise ValueError(
-                "secure_agg and sample are mutually exclusive: the "
-                "mask peer set is the round's full active roster"
-            )
-    if streaming_agg and not (compress_wire and packed_wire):
-        raise ValueError(
-            "streaming_agg requires compress_wire=True and "
-            "packed_wire=True (the streamed unit is the packed wire "
-            "buffer)"
-        )
-    if streaming_agg and aggregator is not None:
-        raise ValueError(
-            "streaming_agg and aggregator are mutually exclusive (a "
-            "custom reducer needs the raw per-party values)"
-        )
-    if error_feedback and not (compress_wire and packed_wire):
-        raise ValueError(
-            "error_feedback requires compress_wire=True and "
-            "packed_wire=True (the residual is carried on the packed "
-            "wire buffer)"
-        )
-    if mode not in ("coordinator", "ring", "hierarchy"):
-        raise ValueError(
-            f"unknown mode {mode!r}: expected 'coordinator', 'ring' or "
-            f"'hierarchy'"
-        )
-    if mode == "hierarchy":
-        if wire_quant is None:
-            raise ValueError(
-                "mode='hierarchy' requires wire_quant: hierarchical "
-                "aggregation is compressed-domain ONLY (float partial "
-                "sums would re-associate a non-associative fold and "
-                "silently break hierarchical == flat byte-identity) — "
-                "pass e.g. wire_quant='uint8'"
-            )
-        if region_size is None or int(region_size) < 1:
-            raise ValueError(
-                "mode='hierarchy' requires region_size= (the "
-                "deterministic partition width of the sorted roster), "
-                f"got {region_size!r}"
-            )
-        if streaming_agg:
-            raise ValueError(
-                "mode='hierarchy' and streaming_agg are mutually "
-                "exclusive: the hierarchy replaces the flat hub "
-                "topology streaming_agg folds on (its fallback path "
-                "streams on its own) — drop streaming_agg"
-            )
-        if sample is not None and sample != len(trainers):
-            raise ValueError(
-                "mode='hierarchy' requires full participation: "
-                "sampling churns the region partition every round, "
-                "re-striping every region ring — use "
-                "mode='coordinator' for sampled rounds"
-            )
-        if secure_agg:
-            raise ValueError(
-                "mode='hierarchy' and secure_agg are mutually "
-                "exclusive: pairwise masks only cancel over the FULL "
-                "party set, so a region's partial sum would be "
-                "un-finalizable ring noise — loud exclusion, never "
-                "silent garbage"
-            )
-        if aggregator is not None:
-            raise ValueError(
-                "mode='hierarchy' and aggregator are mutually "
-                "exclusive (a custom reducer needs the raw per-party "
-                "values at one place)"
-            )
-    if region_size is not None and mode != "hierarchy":
-        raise ValueError(
-            "region_size only applies to mode='hierarchy' (it sets "
-            "the deterministic region partition width)"
-        )
-    if mode == "ring":
-        if not (compress_wire and packed_wire):
-            raise ValueError(
-                "mode='ring' requires compress_wire=True and "
-                "packed_wire=True (the striped unit is the packed wire "
-                "buffer)"
-            )
-        if aggregator is not None:
-            raise ValueError(
-                "mode='ring' and aggregator are mutually exclusive (a "
-                "custom reducer needs the raw per-party values at one "
-                "place)"
-            )
-        if sample is not None and sample != len(trainers):
-            raise ValueError(
-                "mode='ring' requires full participation: sampling "
-                "churns ring membership, re-striping the chunk grid "
-                "and thrashing the per-peer delta caches every round — "
-                "use mode='coordinator' for sampled rounds"
-            )
-        if streaming_agg:
-            raise ValueError(
-                "mode='ring' and streaming_agg are mutually exclusive: "
-                "the ring replaces the hub topology streaming_agg "
-                "folds on (the ring's fallback path streams on its "
-                "own) — drop streaming_agg or use mode='coordinator'"
-            )
-    if coordinator is not None and coordinator not in trainers:
-        raise ValueError(
-            f"coordinator {coordinator!r} is not a training party "
-            f"({sorted(trainers)})"
-        )
-    if ring_chunk_elems is not None and mode not in ("ring", "hierarchy"):
-        raise ValueError(
-            "ring_chunk_elems only applies to mode='ring' or "
-            "mode='hierarchy' (it sets the stripe/chunk grid "
-            "granularity)"
-        )
-    if quorum is not None:
-        if not 1 <= int(quorum) <= len(trainers):
-            raise ValueError(
-                f"quorum must be in [1, {len(trainers)}], got {quorum}"
-            )
-        if not (compress_wire and packed_wire):
-            raise ValueError(
-                "quorum requires compress_wire=True and packed_wire=True "
-                "(the quorum cutoff and the DGA late fold run on the "
-                "packed wire buffer)"
-            )
-        incompat = {
-            "server_opt": server_opt is not None,
-            "aggregator": aggregator is not None,
-            "sample": sample is not None and sample != len(trainers),
-            "error_feedback": error_feedback,
-            "overlap": overlap,
-        }
-        bad = [k for k, v in incompat.items() if v]
-        if bad:
-            raise ValueError(
-                f"quorum is incompatible with {bad}: each needs the "
-                "exact fixed-roster synchronous round boundary that "
-                "k-of-n cutoffs and elastic membership give up"
-            )
-    if round_deadline_s is not None:
-        if quorum is None:
-            raise ValueError(
-                "round_deadline_s only applies with quorum= (it is the "
-                "straggler cutoff of k-of-n rounds)"
-            )
-        if not round_deadline_s > 0:
-            raise ValueError(
-                f"round_deadline_s must be > 0, got {round_deadline_s}"
-            )
-    if join_ticket is not None and quorum is None:
-        raise ValueError(
-            "join_ticket only applies with quorum= (elastic membership "
-            "rides the quorum round protocol)"
-        )
-    if round_log is not None and quorum is None:
-        raise ValueError(
-            "round_log only applies with quorum= (the classic loop has "
-            "a fixed roster — there is nothing to log)"
-        )
-    if overlap:
-        if not (compress_wire and packed_wire):
-            raise ValueError(
-                "overlap=True requires compress_wire=True and "
-                "packed_wire=True (the overlapped aggregation unit is "
-                "the packed wire buffer, and the DGA correction runs on "
-                "it)"
-            )
-        incompat = {
-            "server_opt": server_opt is not None,
-            "aggregator": aggregator is not None,
-            "sample": sample is not None and sample != len(trainers),
-            "error_feedback": error_feedback,
-            "checkpointer": checkpointer is not None,
-        }
-        bad = [k for k, v in incompat.items() if v]
-        if bad:
-            raise ValueError(
-                f"overlap=True is incompatible with {bad}: each needs "
-                "the exact synchronous round boundary (the overlapped "
-                "aggregate lands one round late, under the next round's "
-                "compute)"
-            )
+    # validate_round_config already classified server_opt — dispatch on
+    # ITS verdict so the driver can never disagree with validation.
+    packed_opt = (
+        server_opt if cfg["server_opt_kind"] == "packed" else None
+    )
+    legacy_opt = (
+        server_opt if cfg["server_opt_kind"] == "fedopt" else None
+    )
 
     from rayfed_tpu.fed_object import FedObject
+    from rayfed_tpu.fl.server_opt import (
+        PackedServerOptimizer,
+        check_snapshot_server_opt,
+        describe_server_opt,
+    )
 
-    state = server_opt.init(params) if server_opt is not None else None
+    state = legacy_opt.init(params) if legacy_opt is not None else None
+    sopt = PackedServerOptimizer(packed_opt) if packed_opt is not None else None
+    # The checkpoint stamp for THIS run's server-opt config — every
+    # snapshot carries it, and a restore across differing configs is
+    # refused loudly (a silent momentum reset changes the trajectory
+    # without failing anything).
+    sopt_descr = describe_server_opt(server_opt)
     start_round = 0
 
     # Quorum rounds own their resume story (roster epoch + member log +
@@ -562,13 +753,26 @@ def run_fedavg_rounds(
         and quorum is None
         and checkpointer.latest_round() is not None
     ):
+        check_snapshot_server_opt(
+            checkpointer.load_metadata().get("server_opt"), sopt_descr
+        )
         target = {"params": params}
         if state is not None:
             target["server_state"] = state
+        if sopt is not None:
+            import jax.numpy as _sjnp
+
+            from rayfed_tpu.fl.compression import pack_tree as _pt
+
+            target["server_state"] = packed_opt.init(
+                _pt(params, _sjnp.float32).buf
+            )
         restored_round, snap = checkpointer.restore(target=target)
         params = snap["params"]
         if state is not None:
             state = snap["server_state"]
+        if sopt is not None:
+            sopt.load_state(snap["server_state"])
         start_round = restored_round
         if start_round >= rounds:
             return params
@@ -627,6 +831,7 @@ def run_fedavg_rounds(
             wire_quant=_qname if wire_quant is not None else None,
             secure_agg=secure_agg,
             region_size=region_size,
+            server_opt=packed_opt,
         )
 
     if overlap:
@@ -796,6 +1001,23 @@ def run_fedavg_rounds(
                     # headroom; what still clips rides the EF residual.
                     expand=_QUANT_DELTA_EXPAND,
                 )
+        # Packed server optimization (fl.server_opt): the round's
+        # shared starting buffer anchors the step (applied at the
+        # finalizing node for streaming/quorum/hierarchy, locally on
+        # every controller for ring/classic — deterministic f32 on
+        # byte-identical input either way) and the post-round state
+        # resync every controller runs from the broadcast pair.
+        step_fn = None
+        x_srv = None
+        if sopt is not None:
+            if round_ref is not None:
+                x_srv = round_ref
+            else:
+                from rayfed_tpu.fl.compression import pack_tree as _pt2
+
+                x_srv = _np.asarray(_pt2(current, _jnp.float32).buf)
+            sopt.ensure(x_srv)
+            step_fn = sopt.step_fn(x_srv)
         # Secure aggregation: this party's round masker (pairwise
         # seeds toward every active peer at its own fold weight); the
         # keystream expansion prefetches on a background thread so it
@@ -829,6 +1051,7 @@ def run_fedavg_rounds(
                     updates, weights, stream="fedavg",
                     coordinator=coord, out_dtype=agg_out_dtype,
                     timings=rec,
+                    server_step=step_fn,
                 )
             else:
                 from rayfed_tpu.fl.hierarchy import (
@@ -842,6 +1065,7 @@ def run_fedavg_rounds(
                         updates, weights,
                         region_size=int(region_size),
                         stream="fedavg",
+                        server_step=step_fn,
                         quant=round_grid, quant_ref=round_ref,
                         quant_scope="fedavg",
                         # Quantize the broadcast down the tree too —
@@ -869,6 +1093,11 @@ def run_fedavg_rounds(
                         coordinator=coord, timings=rec,
                         quant=round_grid, quant_ref=round_ref,
                         quant_scope="fedavg",
+                        # The SAME step from the SAME state: the abort
+                        # happened before any resync, so the flat
+                        # re-run's step is bit-identical to the one the
+                        # hierarchy root would have applied.
+                        server_step=step_fn,
                     )
         elif mode == "ring":
             from rayfed_tpu.fl.ring import (
@@ -885,6 +1114,12 @@ def run_fedavg_rounds(
                     quant=round_grid, quant_ref=round_ref,
                     quant_scope="fedavg",
                 )
+                if step_fn is not None:
+                    # The ring has no downlink — every controller holds
+                    # the byte-identical assembled aggregate, so each
+                    # applies the same deterministic f32 step locally
+                    # and all byte-agree on the post-step model.
+                    avg = step_fn(avg)
             except RingRoundError as e:
                 # The abort reached every controller (poison cascade +
                 # commit ring), so all of them take this branch in
@@ -906,9 +1141,11 @@ def run_fedavg_rounds(
                     # fallback re-quantizes the identical codes the
                     # ring round would have folded.  Downlink stays
                     # plain — this is the recovery path, keep it
-                    # simple.
+                    # simple.  The server step re-runs from the same
+                    # (never-resynced) state at the coordinator.
                     quant=round_grid, quant_ref=round_ref,
                     quant_scope="fedavg",
+                    server_step=step_fn,
                 )
         elif streaming_agg:
             from rayfed_tpu.fl.streaming import streaming_aggregate
@@ -921,28 +1158,43 @@ def run_fedavg_rounds(
                 quant=round_grid, quant_ref=round_ref,
                 quant_scope="fedavg",
                 # Quantize the result broadcast too: the downlink is
-                # the other half of the round's bytes.
+                # the other half of the round's bytes.  Under a server
+                # step the coordinator steps FIRST, so the downlink
+                # recode's fresh grid is ranged by the post-step delta.
                 quant_downlink=round_grid is not None,
                 secagg=round_masker,
+                server_step=step_fn,
             )
         else:
             t_a0 = _time.perf_counter() if rec is not None else 0.0
             avg = aggregate(
                 updates, weights, reducer=aggregator, coordinator=coord
             )
+            if step_fn is not None:
+                # Every controller holds the byte-identical broadcast
+                # mean; the deterministic f32 step keeps them agreeing.
+                avg = step_fn(avg)
             if rec is not None:
                 rec["agg_s"] = _time.perf_counter() - t_a0
+        if sopt is not None:
+            # Every controller advances its state replica from the
+            # round's byte-agreed broadcast pair (the broadcast IS the
+            # post-step model) — all replicas stay byte-identical with
+            # zero extra wire bytes (fl.server_opt).
+            sopt.resync(x_srv, _np.asarray(avg.buf))
         if wire_quant is not None:
             # What the grid must cover next round: how far the global
             # model just moved, per block.  Derived from broadcast
-            # values only, so it is bit-identical on every controller.
+            # values only, so it is bit-identical on every controller
+            # (under server_opt: the POST-step delta — the grid ranges
+            # over the model movement the step actually realized).
             quant_prev_delta = (
                 _np.asarray(avg.buf).astype(_np.float32) - round_ref
             )
         if compress_wire:
             avg = decompress(avg)
-        if server_opt is not None:
-            current, state = server_opt.apply(current, avg, state)
+        if legacy_opt is not None:
+            current, state = legacy_opt.apply(current, avg, state)
         else:
             current = avg
         if on_round is not None:
@@ -951,7 +1203,11 @@ def run_fedavg_rounds(
             snap = {"params": current}
             if state is not None:
                 snap["server_state"] = state
-            checkpointer.save(r + 1, snap)
+            if sopt is not None:
+                snap["server_state"] = sopt.state
+            checkpointer.save(
+                r + 1, snap, metadata={"server_opt": sopt_descr}
+            )
         if rec is not None:
             # The aggregation call blocks on this party's own training
             # output before any byte can move, so its measured walls

@@ -74,6 +74,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # integer ring, never approximately) and secagg_overhead_frac <= 0.05
 # (masks ride zero wire bytes and the keystream prefetch hides under
 # the local step, so masking costs at most 5% of a realistic round),
+# the SERVER-OPTIMIZATION gates
+# (fl.server_opt, packed FedAC at the single finalize):
+# fedac_rounds_to_target_frac <= 0.8 (FedAC reaches the quadratic
+# smoke workload's target loss in at most 0.8x plain FedAvg's rounds —
+# the ROUNDS lever, now that the seconds-per-round north-star sits at
+# 0.93; measured ~0.15) and server_opt_agg_bitexact (the POST-step
+# quantized downlink, decoded from serialized wire bytes as a
+# receiving controller would, is byte-identical across the streaming
+# fold, the quorum-cutoff subset refold feeding the step, and the
+# hierarchy's regrouped presummed fold),
 # and the CHAOS gate:
 # under a
 # seeded schedule injecting 1 straggler past the round deadline, 1

@@ -613,6 +613,35 @@ def _run_trainer_streaming(party, cluster):
     )
     last_h = fed.get(trainers["alice"].loss.remote(final_h))
     assert last_h < first, (first, last_h)
+
+    # --- packed server optimization over the same cluster (same child:
+    # the fed-API driver leg of fl.server_opt — quantized rounds, the
+    # coordinator steps before the post-step downlink, every controller
+    # resyncs its state replica from the decoded broadcast) ------------
+    import zlib as _zlib
+
+    from rayfed_tpu.fl import fedac as _fedac
+
+    final_s = run_fedavg_rounds(
+        trainers, params, rounds=4,
+        compress_wire=True, packed_wire=True, streaming_agg=True,
+        wire_quant="uint8", server_opt=_fedac(1.0, 2.0, 0.3),
+    )
+    last_s = fed.get(trainers["alice"].loss.remote(final_s))
+    assert last_s < first, (first, last_s)
+
+    # Byte agreement: each party fingerprints ITS OWN final tree — the
+    # post-step broadcasts must have kept the controllers identical.
+    def _fp(tree):
+        return _zlib.crc32(
+            np.asarray(C.pack_tree(tree, jnp.float32).buf).tobytes()
+        )
+
+    fpr = fed.remote(_fp)
+    fps = fed.get(
+        [fpr.party(p).remote(final_s) for p in ("alice", "bob")]
+    )
+    assert fps[0] == fps[1], fps
     fed.shutdown()
 
 

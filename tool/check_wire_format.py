@@ -183,6 +183,26 @@ def compute_fingerprint() -> str:
     )
     quant_grid_descriptor = qz.grid_descriptor(grid)
 
+    # Server optimization (fl.server_opt): the POST-step downlink rides
+    # the existing quantized-downlink machinery unchanged — the fresh
+    # grid is simply ranged by the post-step delta and ships under the
+    # same wire.QUANT_GRID_KEY descriptor fingerprinted above.  Assert
+    # the module introduces NO frame-metadata key of its own: a future
+    # key must be declared in transport/wire.py, where FED006 and the
+    # frame_metadata_keys fingerprint below police it.
+    from rayfed_tpu.fl import server_opt as fl_server_opt
+
+    _sopt_keys = [
+        k for k in dir(fl_server_opt)
+        if k.endswith("_KEY") and not k.startswith("_")
+    ]
+    if _sopt_keys:
+        raise AssertionError(
+            f"fl.server_opt declares frame-metadata-style key(s) "
+            f"{_sopt_keys} — declare frame metadata keys in "
+            f"transport/wire.py so this lock fingerprints them"
+        )
+
     material = json.dumps(
         {
             "manifest_schema": _schema(manifest),
