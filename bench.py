@@ -1208,6 +1208,199 @@ def _fill_secagg_extra(extra: dict, s: dict) -> None:
     )
 
 
+def _run_objectplane_bench(_party: str, result_q) -> None:
+    """Content-addressed pull-on-demand object plane (transport/
+    objectstore.py): welcome-by-handle vs the eager welcome push, and
+    concurrent-fetch dedup.
+
+    In-process 4-manager shape (real loopback sockets) like the secagg
+    bench.  Three measurements:
+
+    1. **Eager welcome** — the coordinator pushes a welcome carrying
+       the model inline (the pre-object-plane behavior): the baseline
+       payload bytes.
+    2. **Warm rejoin by handle** — the joiner's content cache already
+       holds the round model (what every quorum participant publishes
+       per round, so a graceful leave/rejoin inside one round is warm):
+       the welcome carries only the FINGERPRINT handle, the resolve is
+       a cache hit, and ~zero payload bytes cross the wire.  Gate
+       (test.sh): ``rejoin_welcome_bytes_frac <= 0.1``.
+    3. **Dedup** — N concurrent local fetches of one cold fingerprint
+       trigger exactly ONE wire transfer from the holder.  Gate:
+       ``blob_dedup_single_transfer``.
+
+    A cold handle rejoin is also reported (``blob_pull_GBps`` — the
+    BLOB_GET/BLOB_PUT pull path at payload scale) but not gated: cold
+    moves the same bytes as eager, just by pull.
+    """
+    import socket
+    import threading
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from rayfed_tpu import objects as rf_objects
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.transport.manager import TransportManager
+
+    parties = ("alice", "bob", "carol", "dave")
+
+    def free_ports(k):
+        socks = [socket.socket() for _ in range(k)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports_ = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        return ports_
+
+    ports = dict(zip(parties, free_ports(len(parties))))
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                p: PartyConfig.from_dict(
+                    {"address": f"127.0.0.1:{ports[p]}"}
+                )
+                for p in parties
+            },
+            current_party=party,
+        )
+        return TransportManager(
+            cc, JobConfig(device_put_received=False, cross_silo_timeout_s=60),
+        )
+
+    mgrs = {p: mk(p) for p in parties}
+    for m in mgrs.values():
+        m.start()
+
+    n = 1 << 20  # ~4 MB f32 model — payload-scale, sockets-real
+    rng = np.random.default_rng(0)
+
+    def model(r):
+        return fl_comp.pack_tree(
+            {"w": jnp.asarray(
+                rng.standard_normal(n).astype(np.float32) + r
+            )},
+            jnp.float32,
+        )
+
+    def payload_bytes(mgr):
+        return mgr.get_stats()["send_payload_bytes"]
+
+    def welcome_of(m_r, handle=None):
+        w = {"round": 1, "session": "op", "epoch": 1,
+             "members": list(parties), "coordinator": "alice"}
+        if handle is None:
+            w["params"] = m_r
+        else:
+            w["model"] = handle
+        return w
+
+    # --- 1. eager welcome baseline (alice -> dave, params inline) ----
+    m0 = model(0)
+    m0c = rf_objects.canonical_host(m0)
+    b0 = payload_bytes(mgrs["alice"])
+    mgrs["alice"].send("dave", welcome_of(m0), "w.eager", "roster")
+    eager_val = mgrs["dave"].recv("alice", "w.eager", "roster").resolve(
+        timeout=120
+    )["params"]
+    eager_bytes = payload_bytes(mgrs["alice"]) - b0
+
+    # --- 2a. COLD handle rejoin (carol has nothing cached) -----------
+    fp, nb = mgrs["alice"].objects.publish(m0c)
+    handle = mgrs["alice"].objects.handle_for(fp, nb)
+    b1 = payload_bytes(mgrs["alice"])
+    t0 = time.perf_counter()
+    mgrs["alice"].send("carol", welcome_of(None, handle), "w.cold", "roster")
+    wc = mgrs["carol"].recv("alice", "w.cold", "roster").resolve(timeout=120)
+    cold_val = rf_objects.maybe_resolve_handle(mgrs["carol"], wc["model"])
+    cold_s = time.perf_counter() - t0
+    cold_bytes = payload_bytes(mgrs["alice"]) - b1
+
+    # --- 2b. WARM handle rejoin (dave's cache holds the model) -------
+    # Every quorum participant publishes each round's broadcast; a
+    # leaver that rejoins within the round IS this warm case.  dave
+    # decoded the eager welcome above — publishing its value derives
+    # the SAME fingerprint alice's handle names.
+    mgrs["dave"].objects.publish(rf_objects.canonical_host(eager_val))
+    b2 = payload_bytes(mgrs["alice"])
+    mgrs["alice"].send("dave", welcome_of(None, handle), "w.warm", "roster")
+    ww = mgrs["dave"].recv("alice", "w.warm", "roster").resolve(timeout=120)
+    warm_val = rf_objects.maybe_resolve_handle(mgrs["dave"], ww["model"])
+    warm_bytes = payload_bytes(mgrs["alice"]) - b2
+
+    # Byte-identity across all three paths (the acceptance identity:
+    # handle-resolved state == eager-push state, receiver-decoded).
+    identical = bool(
+        np.array_equal(np.asarray(eager_val.buf), np.asarray(cold_val.buf))
+        and np.array_equal(
+            np.asarray(eager_val.buf), np.asarray(warm_val.buf)
+        )
+    )
+
+    # --- 3. concurrent-fetch single-transfer dedup -------------------
+    m1 = model(1)
+    fp1, nb1 = mgrs["alice"].objects.publish(
+        rf_objects.canonical_host(m1)
+    )
+    h1 = mgrs["alice"].objects.handle_for(fp1, nb1)
+    serves0 = mgrs["alice"].objects.stats["blob_serves"]
+    errs: list = []
+
+    def _fetch():
+        try:
+            mgrs["bob"].objects.fetch(h1, timeout_s=120)
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    threads = [threading.Thread(target=_fetch) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    serves = mgrs["alice"].objects.stats["blob_serves"] - serves0
+    dedup_ok = bool(not errs and serves == 1)
+
+    for m in mgrs.values():
+        m.stop()
+    result_q.put((
+        "object_plane",
+        {
+            "eager_welcome_bytes": int(eager_bytes),
+            "cold_welcome_bytes": int(cold_bytes),
+            "warm_welcome_bytes": int(warm_bytes),
+            "rejoin_welcome_bytes_frac": (
+                warm_bytes / eager_bytes if eager_bytes else 1.0
+            ),
+            "blob_pull_GBps": (nb / cold_s / 1e9) if cold_s > 0 else 0.0,
+            "dedup_single_transfer": dedup_ok,
+            "dedup_serves": int(serves),
+            "handle_state_identical": identical,
+        },
+    ))
+
+
+def _fill_objectplane_extra(extra: dict, s: dict) -> None:
+    extra["rejoin_welcome_bytes_frac"] = round(
+        s["rejoin_welcome_bytes_frac"], 4
+    )
+    extra["blob_dedup_single_transfer"] = s["dedup_single_transfer"]
+    extra["blob_handle_state_identical"] = s["handle_state_identical"]
+    extra["blob_pull_GBps"] = round(s["blob_pull_GBps"], 3)
+    extra["eager_welcome_bytes"] = s["eager_welcome_bytes"]
+    extra["warm_welcome_bytes"] = s["warm_welcome_bytes"]
+    _log(
+        f"  object plane: warm rejoin {s['warm_welcome_bytes']} B vs "
+        f"eager {s['eager_welcome_bytes']} B "
+        f"(frac {s['rejoin_welcome_bytes_frac']:.4f}); cold pull "
+        f"{s['blob_pull_GBps']:.2f} GB/s; dedup single transfer: "
+        f"{s['dedup_single_transfer']} ({s['dedup_serves']} serve(s) "
+        f"for 6 concurrent fetches)"
+    )
+
+
 def _run_hierarchy_bench(_party: str, result_q) -> None:
     """Hierarchical aggregation traffic-vs-N: region rings + quantized
     cross-region partial-sum streaming at N ∈ {4, 16, 64}
@@ -4051,6 +4244,11 @@ def main() -> None:
             sv = _one_child("_run_server_opt_bench", ndev=1,
                             timeout=420)
             _fill_server_opt_extra(extra, sv)
+        with _section(extra, "object_plane"):
+            _log("object-plane smoke (welcome-by-handle vs eager push, "
+                 "concurrent-fetch dedup, 4 managers)...")
+            op = _one_child("_run_objectplane_bench", ndev=1, timeout=420)
+            _fill_objectplane_extra(extra, op)
         with _section(extra, "hierarchy"):
             _log("hierarchical-aggregation smoke (region rings + "
                  "quantized cross-region streaming, traffic-vs-N at "
@@ -4083,6 +4281,7 @@ def main() -> None:
             or "compressed_agg_error" in extra
             or "secagg_error" in extra
             or "server_opt_error" in extra
+            or "object_plane_error" in extra
             or "hierarchy_error" in extra
             or "chaos_error" in extra
         ):
@@ -4168,6 +4367,33 @@ def main() -> None:
                 f"secagg smoke gate FAILED: secagg_overhead_frac={sof} "
                 f"(masked rounds must cost <= 5% over plain quantized "
                 f"rounds)"
+            )
+            raise SystemExit(1)
+        # CI gates (test.sh): the object plane must actually deliver
+        # pull-on-demand — (1) a WARM welcome-by-handle rejoin moves at
+        # most 0.1x the eager welcome push's payload bytes (the handle
+        # is a few hundred bytes; a cache hit pulls nothing), (2) N
+        # concurrent fetches of one fingerprint trigger exactly ONE
+        # wire transfer (in-flight dedup), and (3) handle-resolved
+        # state is byte-identical to the eager-push state.
+        rwf = extra.get("rejoin_welcome_bytes_frac")
+        if rwf is None or rwf > 0.1:
+            _log(
+                f"object-plane smoke gate FAILED: "
+                f"rejoin_welcome_bytes_frac={rwf} (a warm rejoin must "
+                f"move <= 0.1x the eager welcome's payload bytes)"
+            )
+            raise SystemExit(1)
+        if not extra.get("blob_dedup_single_transfer"):
+            _log(
+                "object-plane smoke gate FAILED: concurrent fetches of "
+                "one fingerprint did not collapse to a single transfer"
+            )
+            raise SystemExit(1)
+        if not extra.get("blob_handle_state_identical"):
+            _log(
+                "object-plane smoke gate FAILED: handle-resolved model "
+                "!= eager-push model (receiver-decoded bytes)"
             )
             raise SystemExit(1)
         # CI gates (test.sh): hierarchical aggregation must scale flat

@@ -498,17 +498,39 @@ def get(
                     data=local_ref,
                     upstream_seq_id=fed_object.get_fed_task_id(),
                     downstream_seq_id=fake_fed_task_id,
+                    # Large immutable objects (plain PackedTrees at or
+                    # above JobConfig.blob_broadcast_min_bytes) ship as
+                    # fingerprint handles: receivers with a content-
+                    # cache hit transfer ZERO payload bytes, misses
+                    # pull from this owner (transport/objectstore.py).
+                    blob_offer=True,
                 )
         else:
             cached = fed_object.get_local_ref()
             if cached is not None:
                 refs.append(cached)
             else:
+                from rayfed_tpu.objects import maybe_resolve_handle
+
+                plane = getattr(runtime.transport, "objects", None)
                 received = recv_on_runtime(
                     runtime,
                     src_party=fed_object.get_party(),
                     upstream_seq_id=fed_object.get_fed_task_id(),
                     curr_seq_id=fake_fed_task_id,
+                ).then(
+                    # A broadcast that arrived as a fingerprint handle
+                    # resolves through the object plane (cache hit =
+                    # zero-copy, miss = BLOB_GET pull); ordinary
+                    # payloads pass through untouched.  A cold pull
+                    # BLOCKS for a holder round trip, so it runs on the
+                    # plane's dedicated fetch pool — never the shared
+                    # codec pool, which must stay free to decode and to
+                    # SERVE the symmetric pulls of other parties.
+                    lambda v: maybe_resolve_handle(runtime.transport, v),
+                    executor=(
+                        plane.fetch_executor if plane is not None else None
+                    ),
                 )
                 fed_object._cache_local_ref(received)
                 refs.append(received)

@@ -39,12 +39,13 @@ except Exception:  # pragma: no cover
 
 
 def _to_host(tree: Any) -> Any:
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x))
-        if isinstance(x, (jax.Array, np.ndarray))
-        else x,
-        tree,
-    )
+    # Delegates to the object plane's residency normalizer: checkpoint
+    # fingerprints and welcome/model fingerprints must agree on ONE
+    # canonical host form, or value-equal trees would silently split
+    # the content space (see objects.canonical_host).
+    from rayfed_tpu.objects import canonical_host
+
+    return canonical_host(tree)
 
 
 class FedCheckpointer:
@@ -60,6 +61,7 @@ class FedCheckpointer:
         *,
         max_to_keep: int = 3,
         use_orbax: Optional[bool] = None,
+        object_plane: Any = None,
     ) -> None:
         self._dir = os.path.join(os.path.abspath(directory), party)
         os.makedirs(self._dir, exist_ok=True)
@@ -68,6 +70,24 @@ class FedCheckpointer:
         self._use_orbax = _HAVE_ORBAX if use_orbax is None else use_orbax
         if self._use_orbax and not _HAVE_ORBAX:  # pragma: no cover
             raise RuntimeError("orbax requested but not importable")
+        # Content-addressed fast path (transport/objectstore.py): save
+        # stamps each snapshot's wire-bytes fingerprint into meta.json
+        # and publishes the bytes into the party's object plane;
+        # restore resolves the fingerprint against the content cache
+        # BEFORE touching disk — a warm restore (same process, or the
+        # blob still cached from the round loop) decodes from memory.
+        # Explicit object_plane= overrides the runtime discovery (tests
+        # and standalone tooling).
+        self._object_plane = object_plane
+
+    def _plane(self):
+        if self._object_plane is not None:
+            return self._object_plane
+        from rayfed_tpu.runtime import get_runtime_or_none
+
+        runtime = get_runtime_or_none()
+        transport = getattr(runtime, "transport", None) if runtime else None
+        return getattr(transport, "objects", None)
 
     # -- paths ---------------------------------------------------------------
 
@@ -107,8 +127,34 @@ class FedCheckpointer:
     # -- save / restore ------------------------------------------------------
 
     def save(self, round_num: int, state: Any, *, metadata: Optional[dict] = None):
-        """Snapshot ``state`` (any pytree) as round ``round_num``."""
+        """Snapshot ``state`` (any pytree) as round ``round_num``.
+
+        Beside the on-disk snapshot, the state's serialized wire bytes
+        are fingerprinted (``wire.blob_fingerprint`` — the same single
+        producer welcome handles use) and published into the party's
+        object plane when one is available; ``meta.json`` carries the
+        stamp so :meth:`restore` can resolve the snapshot by CONTENT
+        before touching disk."""
         host_state = _to_host(state)
+        blob_stamp: dict = {}
+        plane = self._plane()
+        if plane is not None:
+            try:
+                from rayfed_tpu import objects as _objects
+
+                fp, data = _objects.fingerprint_value(host_state)
+                # Unpinned: the cached snapshot is a warm-restore
+                # OPTIMIZATION with a durable disk fallback — it must
+                # never permanently consume budget the live round
+                # state (pinned models, broadcast offers) needs.
+                plane.publish(data=data)
+                blob_stamp = {"blob_fp": fp, "blob_n": len(data)}
+            except Exception:  # pragma: no cover - plane must not
+                logger.exception(  # break the durable disk path
+                    "[%s] checkpoint blob publish failed; disk "
+                    "snapshot proceeds without a fingerprint stamp",
+                    self._party,
+                )
         path = self._round_dir(round_num)
         tmp = path + ".tmp"
         if os.path.exists(tmp):
@@ -125,7 +171,8 @@ class FedCheckpointer:
             )
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(
-                {"round": round_num, "party": self._party, **(metadata or {})}, f
+                {"round": round_num, "party": self._party, **blob_stamp,
+                 **(metadata or {})}, f
             )
         # Keep a complete checkpoint under SOME name at every instant: move
         # the old round aside, promote the new one, then drop the old copy —
@@ -159,6 +206,13 @@ class FedCheckpointer:
             round_num = self.latest_round()
             if round_num is None:
                 raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        # Content-addressed fast path: resolve the snapshot by its
+        # fingerprint stamp BEFORE touching the state files — a cache
+        # hit decodes the exact saved bytes from memory (the meta.json
+        # stamp is still read from disk: it is what names the content).
+        cached = self._restore_from_blob(round_num)
+        if cached is not None:
+            return round_num, cached
         path = self._round_dir(round_num)
         if self._use_orbax:
             ckpt = ocp.PyTreeCheckpointer()
@@ -178,6 +232,45 @@ class FedCheckpointer:
             leaves = [data[f"leaf_{i}"] for i in range(len(t_leaves))]
             state = jax.tree_util.tree_unflatten(t_def, leaves)
         return round_num, state
+
+    def _restore_from_blob(self, round_num: int) -> Optional[Any]:
+        """The state pytree for ``round_num`` decoded from the object
+        plane's content cache, or ``None`` (no plane, no stamp, cache
+        miss, or a decode problem — every miss falls back to disk).
+
+        The decode restores the EXACT saved container structure (the
+        wire codec's skeleton), so no ``target`` re-attachment is
+        needed, and the bytes are the fingerprinted ones — content
+        equality is structural, not trusted."""
+        plane = self._plane()
+        if plane is None:
+            return None
+        try:
+            meta_path = os.path.join(self._round_dir(round_num), "meta.json")
+            with open(meta_path) as f:
+                fp = json.load(f).get("blob_fp")
+        except OSError:
+            return None
+        if not fp:
+            return None
+        data = plane.fetch_local_bytes(fp)
+        if data is None:
+            return None
+        try:
+            from rayfed_tpu import objects as _objects
+
+            state = _objects.deserialize_blob(data)
+        except Exception:  # pragma: no cover - corrupt cache entry
+            logger.exception(
+                "[%s] checkpoint blob %s failed to decode; falling "
+                "back to the disk snapshot", self._party, fp,
+            )
+            return None
+        logger.info(
+            "[%s] checkpoint round %d restored from the content cache "
+            "(%s) — disk state untouched", self._party, round_num, fp,
+        )
+        return state
 
     def load_metadata(self, round_num: Optional[int] = None) -> dict:
         """The ``meta.json`` of one round's snapshot (latest by

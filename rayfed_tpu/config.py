@@ -160,3 +160,23 @@ class JobConfig:
     peer_failfast: bool = True
     peer_health_interval_s: float = 2.0
     peer_death_pings: int = 3
+    # Content-addressed pull-on-demand object plane (transport/
+    # objectstore.py).  blob_cache_budget_bytes bounds the per-party
+    # content cache (pinned live-round state may exceed it; unpinned
+    # entries evict LRU-first).  blob_broadcast_min_bytes: a fed.get
+    # broadcast of a plain PackedTree at/above this size sends a
+    # fingerprint HANDLE instead of the payload — receivers with a
+    # content-cache hit transfer zero payload bytes, misses pull via
+    # BLOB_GET.  None disables handle offers (required when any
+    # RECEIVING party is a multi-host group: non-leader bridge
+    # processes cannot pull).
+    blob_cache_budget_bytes: int = 256 * 1024 * 1024
+    blob_broadcast_min_bytes: Optional[int] = 8 * 1024 * 1024
+    # Quorum rounds: publish each round's broadcast model into the
+    # content cache on EVERY controller (one host copy + chunk-CRC +
+    # sha256 per round) — what makes every member a named welcome
+    # holder and a graceful leaver's rejoin warm.  Turn off for very
+    # large models where that per-round cost outweighs rejoin savings:
+    # welcomes still work (the coordinator publishes at welcome time;
+    # member holders just reply miss → failover).
+    blob_publish_round_models: bool = True

@@ -504,7 +504,12 @@ class MultiHostTransport:
 
     def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
              stream=None, round_tag=None, epoch_tag=None,
-             quant_meta=None):
+             quant_meta=None, blob_offer=False):
+        # blob_offer is deliberately dropped: a multi-host party never
+        # offers fingerprint handles — the RECEIVER may itself be a
+        # multi-host group whose non-leader bridge processes cannot
+        # pull, so its broadcasts stay eager pushes.
+        del blob_offer
         if self._inner is not None:
             return self._inner.send(
                 dest_party=dest_party,
@@ -521,9 +526,11 @@ class MultiHostTransport:
 
     def send_many(self, dest_parties, data, upstream_seq_id,
                   downstream_seq_id, stream=None, round_tag=None,
-                  epoch_tag=None, quant_meta=None):
+                  epoch_tag=None, quant_meta=None, blob_offer=False):
         """Fan-out broadcast (one shared encode) — leader only; see
-        :meth:`TransportManager.send_many`."""
+        :meth:`TransportManager.send_many`.  ``blob_offer`` is dropped
+        (see :meth:`send`): multi-host parties broadcast eagerly."""
+        del blob_offer
         if self._inner is not None:
             return self._inner.send_many(
                 dest_parties=dest_parties,
@@ -639,6 +646,17 @@ class MultiHostTransport:
                 "wire to agree keys over"
             )
         return self._inner.ensure_secagg_peer_keys(parties, timeout_s)
+
+    @property
+    def objects(self):
+        """Content-addressed object plane (transport/objectstore.py) —
+        leader-only like every cross-party plane: the leader's manager
+        serves and pulls blobs.  None on non-leaders; handle resolution
+        on one fails loudly (``objects.maybe_resolve_handle``) instead
+        of handing user code a raw handle dict."""
+        if self._inner is not None:
+            return self._inner.objects
+        return None
 
     def set_max_message_size(self, max_bytes: int) -> None:
         """Runtime message-size cap mutation — NOT supported for

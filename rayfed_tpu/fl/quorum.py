@@ -798,14 +798,6 @@ def run_quorum_rounds(
                 "masked recovery window has not been exercised with a "
                 "post-finalize step (loud exclusion, fl.server_opt)"
             )
-        if join_ticket is not None:
-            raise QuorumRoundError(
-                "a join_ticket cannot enter a server_opt run: the "
-                "welcome does not carry the server-optimizer state, so "
-                "the joiner's replica would silently reset the "
-                "trajectory on its first coordinator lease (loud "
-                "exclusion, fl.server_opt)"
-            )
         sopt = PackedServerOptimizer(server_opt)
     from rayfed_tpu.fl.server_opt import describe_server_opt
 
@@ -890,6 +882,14 @@ def run_quorum_rounds(
         # instead of desyncing into an unquantized bootstrap.
         if wire_quant is not None:
             quant_prev_delta = join_ticket.get("qd")
+        # Server-opt runs: the welcome carries the optimizer spec + a
+        # handle to the replicated state (resolved through the object
+        # plane), so a joiner resyncs the trajectory instead of being
+        # a loud exclusion.  Both sides must agree on the spec — a
+        # silent mismatch IS the trajectory reset this guards against.
+        _apply_ticket_server_opt(
+            runtime.transport, join_ticket, sopt, sopt_descr
+        )
     elif restored is not None:
         start_round, session, params = restored
         if start_round >= rounds:
@@ -1136,6 +1136,20 @@ def run_quorum_rounds(
             "members": list(members), "coordinator": coord,
         })
         current = avg
+        plane = getattr(transport, "objects", None)
+        if plane is not None and runtime.job_config.blob_publish_round_models:
+            # Every controller publishes the round broadcast into its
+            # content cache (pinned in the "model" slot; the previous
+            # round's entry becomes an ordinary LRU citizen).  This is
+            # what makes every member a named HOLDER in welcome
+            # handles, keeps a graceful leaver's cache warm for a
+            # zero-payload rejoin, and seeds checkpoint-by-fingerprint.
+            # Residency-canonicalized so every controller — device-held
+            # coordinator aggregate or decoded member view — derives
+            # the IDENTICAL fingerprint from the byte-agreed values.
+            from rayfed_tpu.objects import canonical_host
+
+            plane.publish_slot("model", canonical_host(current))
         if sopt is not None:
             # Every controller advances its state replica from the
             # round's byte-agreed broadcast pair — the broadcast IS the
@@ -1167,6 +1181,10 @@ def run_quorum_rounds(
                 runtime, outcome.welcomes, roster, current, r + 1,
                 session, backstop, coordinator=next_coord,
                 quant_delta=quant_prev_delta,
+                server_opt_descr=sopt_descr,
+                # The post-resync state — what anchors round r+1 on
+                # every controller; the joiner loads exactly it.
+                server_state=sopt.state if sopt is not None else None,
             )
         coord = next_coord
         if checkpointer is not None and checkpoint_every and (
@@ -1434,9 +1452,60 @@ def _restore_quorum_snapshot(checkpointer, params, roster, log,
             snap["params"])
 
 
+def _normalize_server_opt_descr(descr) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"kind": str(descr.get("kind", "none"))}
+    if "hyper" in descr:
+        out["hyper"] = [float(h) for h in descr["hyper"]]
+    return out
+
+
+def _apply_ticket_server_opt(transport, join_ticket, sopt,
+                             sopt_descr) -> None:
+    """Validate and apply a welcome's server-opt spec + state handle.
+
+    Every mismatch is LOUD, naming both sides: a joiner entering a
+    FedAC run as plain FedAvg (or with different hyperparameters, or
+    without the state) would silently reset the optimizer trajectory
+    for the whole run the first time it holds the coordinator lease.
+    """
+    t_descr = join_ticket.get("server_opt")
+    mine = _normalize_server_opt_descr(sopt_descr)
+    if t_descr is not None:
+        theirs = _normalize_server_opt_descr(t_descr)
+        if theirs != mine:
+            raise QuorumRoundError(
+                f"server_opt mismatch between this joiner and the run "
+                f"it is entering: the welcome was stamped {theirs}, "
+                f"this run_fedavg_rounds call is configured {mine} — "
+                f"pass the matching server_opt"
+            )
+    elif sopt is not None:
+        raise QuorumRoundError(
+            f"this run is configured with server_opt={mine} but the "
+            f"welcome carries no server_opt stamp (a coordinator from "
+            f"before welcomes carried optimizer state?) — the joiner "
+            f"cannot resync the trajectory; restart the run or drop "
+            f"server_opt"
+        )
+    if sopt is None:
+        return
+    state_handle = join_ticket.get("server_state")
+    if state_handle is None:
+        raise QuorumRoundError(
+            "the welcome stamps a packed server_opt but carries no "
+            "server_state handle — cannot resync the optimizer "
+            "trajectory"
+        )
+    from rayfed_tpu.objects import maybe_resolve_handle
+
+    state = maybe_resolve_handle(transport, state_handle)
+    sopt.load_state(state)
+
+
 def _send_welcomes(runtime, welcomes, roster, current, next_round,
                    session, backstop, coordinator: str,
-                   quant_delta=None) -> None:
+                   quant_delta=None, server_opt_descr=None,
+                   server_state=None) -> None:
     """Coordinator: hand each joiner everything it needs to enter the
     loop at the next round — round index, session, the current roster
     epoch, the CURRENT coordinator (post-handover, so a rejoiner never
@@ -1445,8 +1514,44 @@ def _send_welcomes(runtime, welcomes, roster, current, next_round,
     shared grid derives from.  Best-effort: a joiner that died again
     simply re-requests later.  Direct transport send — see
     quorum_aggregate on why membership control traffic skips the
-    cleanup send-watchdog."""
+    cleanup send-watchdog.
+
+    **Handle-passing (object plane)**: when the transport carries an
+    object plane, the welcome names the model by content fingerprint
+    (``"model"``: a blob handle whose holders are the coordinator plus
+    every current member — each publishes the round broadcast into its
+    plane, see the round loop) instead of inlining ``"params"``.  The
+    joiner pulls from any live holder; a WARM joiner (its cache still
+    holds the current model, e.g. a graceful leave/rejoin inside one
+    round) transfers ~zero payload bytes.  ``server_opt_descr`` /
+    ``server_state`` (packed server-opt runs): the welcome carries the
+    optimizer spec plus a handle to the replicated state, so a joiner
+    resyncs the trajectory through the object plane instead of being a
+    loud exclusion (ROADMAP item 4 follow-on).
+    """
+    from rayfed_tpu.objects import canonical_host
+
     epoch, members = roster.snapshot()
+    plane = getattr(runtime.transport, "objects", None)
+    shared: Dict[str, Any] = {}
+    if plane is not None:
+        # Content-addressed dedup: the round loop already published
+        # exactly these canonical bytes, so the store keeps ONE copy
+        # (this re-derives the fingerprint, which refreshes the entry).
+        fp, n = plane.publish(canonical_host(current))
+        shared["model"] = plane.handle_for(fp, n, extra_holders=members)
+    else:
+        shared["params"] = current
+    if server_opt_descr is not None:
+        shared["server_opt"] = dict(server_opt_descr)
+    if server_state is not None:
+        if plane is None:
+            raise QuorumRoundError(
+                "a server_opt run's welcome needs the object plane to "
+                "carry the optimizer state; this transport has none"
+            )
+        sfp, sn = plane.publish(canonical_host(server_state))
+        shared["server_state"] = plane.handle_for(sfp, sn)
     for party, nonce in welcomes:
         payload = {
             "round": int(next_round),
@@ -1454,7 +1559,7 @@ def _send_welcomes(runtime, welcomes, roster, current, next_round,
             "epoch": int(epoch),
             "members": list(members),
             "coordinator": coordinator,
-            "params": current,
+            **shared,
         }
         if quant_delta is not None:
             payload["qd"] = quant_delta
@@ -1521,6 +1626,18 @@ def join_cluster(
     welcome = recv_on_runtime(
         runtime, coord, f"roster.welcome.{me}.{nonce}", "roster"
     ).resolve(timeout=backstop)
+    if "model" in welcome and "params" not in welcome:
+        # Handle-passing welcome (object plane): resolve the model by
+        # content fingerprint — a warm rejoiner (cache still holds the
+        # current model) transfers ~zero payload bytes; a cold one
+        # pulls from the coordinator or any named member, with dead-
+        # holder failover.  The decoded bytes are IDENTICAL to what an
+        # eager-push welcome would have delivered (same wire codec).
+        from rayfed_tpu.objects import maybe_resolve_handle
+
+        welcome["params"] = maybe_resolve_handle(
+            runtime.transport, welcome["model"], timeout=backstop
+        )
     runtime.transport.roster.apply(welcome["epoch"], welcome["members"])
     logger.info(
         "[%s] joined at round %d (roster epoch %d, members %s)",

@@ -414,10 +414,10 @@ def validate_round_config(
             # legacy tree path is the one with sampling history; the
             # packed step has no sampled-round test yet.
             "sample": sample is not None and sample != len(trainers),
-            # The welcome does not carry server-opt state; a joiner
-            # would silently reset the trajectory on its first
-            # coordinator lease.
-            "join_ticket": join_ticket is not None,
+            # join_ticket COMPOSES since the object plane landed:
+            # welcomes carry the server-opt spec + a content handle to
+            # the replicated state, and the joiner resyncs through the
+            # pull path (loud spec-mismatch guard in fl.quorum).
         }
         bad_s = [k for k, v in incompat_s.items() if v]
         if bad_s:
@@ -488,8 +488,10 @@ def run_fedavg_rounds(
       controller steps the byte-identical assembly locally) and
       ``mode="hierarchy"`` (the root steps once; the tree broadcast
       carries the post-step model); requires ``compress_wire`` +
-      ``packed_wire``; loudly excluded with ``overlap``/``secure_agg``/
-      ``error_feedback``/``aggregator``/``sample``/``join_ticket`` —
+      ``packed_wire``; composes with ``join_ticket`` (welcomes carry
+      the spec + a content handle to the replicated state, resolved
+      through the object plane); loudly excluded with ``overlap``/
+      ``secure_agg``/``error_feedback``/``aggregator``/``sample`` —
       see :mod:`rayfed_tpu.fl.server_opt` and
       ``docs/source/server_optimization.rst``.  A legacy
       :mod:`rayfed_tpu.fl.fedopt` ``ServerOptimizer`` keeps the

@@ -61,6 +61,7 @@ def send_many_on_runtime(
     round_tag: Any = None,
     epoch_tag: Any = None,
     quant_meta: Any = None,
+    blob_offer: bool = False,
 ) -> dict:
     """Broadcast fan-out: ONE payload encode shared by every destination.
 
@@ -69,6 +70,10 @@ def send_many_on_runtime(
     broadcast-on-get cost becomes max(per-peer wire time), not
     N × (encode + wire).  Each per-party result ref registers with the
     cleanup watchdog exactly like a single send.
+
+    ``blob_offer=True``: large immutable payloads may ship as
+    fingerprint handles resolved pull-on-demand by the receivers — see
+    :meth:`TransportManager.send_many`.
     """
     if runtime.send_proxy is None:
         raise RuntimeError("transport not started; call fed.init() first")
@@ -81,6 +86,7 @@ def send_many_on_runtime(
         round_tag=round_tag,
         epoch_tag=epoch_tag,
         quant_meta=quant_meta,
+        blob_offer=blob_offer,
     )
     if runtime.cleanup_manager is not None:
         for ref in refs.values():

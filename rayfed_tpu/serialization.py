@@ -42,6 +42,10 @@ _INTERNAL_ALLOWED = {
     # Hierarchical aggregation: a region's integer partial sum on the
     # shared grid (rayfed_tpu.fl.hierarchy).
     ("rayfed_tpu.fl.hierarchy", "RegionSumTree"),
+    # Server-optimizer replicated state (rayfed_tpu.fl.server_opt):
+    # travels the wire exactly once per joiner, inside the object-plane
+    # blob a welcome's server_state handle names.
+    ("rayfed_tpu.fl.server_opt", "PackedServerState"),
     ("jax._src.tree_util", "default_registry"),
 }
 

@@ -329,6 +329,18 @@ class TransportManager:
         # fl/secagg.py derives pairwise mask seeds from it.
         self.secagg_keys = secagg_keys.KeyAgreement(self._party)
         self._server.secagg = self.secagg_keys
+        # Content-addressed pull-on-demand object plane (transport/
+        # objectstore.py): fingerprint handles for large immutable
+        # objects, BLOB_GET/BLOB_PUT pulls on the existing frame
+        # machinery, bounded content cache.  The observer consumes
+        # BLOB_GET request frames like the membership observer consumes
+        # roster requests.
+        from rayfed_tpu.transport.objectstore import ObjectPlane
+
+        self.objects = ObjectPlane(
+            self, budget_bytes=job_config.blob_cache_budget_bytes
+        )
+        self._server._observers.append(self.objects._observe_request)
         # Set by api.init: () -> Optional[jax.sharding.Mesh].  Received
         # shard-encoded leaves whose sender sharding fits this mesh are
         # device_put with the equivalent local NamedSharding.
@@ -570,6 +582,7 @@ class TransportManager:
         self._loop.close()
         self._loop_thread = None
         self._codec_pool.shutdown(wait=False)
+        self.objects.close()
 
     # -- client construction --------------------------------------------------
 
@@ -838,6 +851,7 @@ class TransportManager:
         round_tag: Optional[int] = None,
         epoch_tag: Optional[int] = None,
         quant_meta: Optional[Dict[str, Any]] = None,
+        blob_offer: bool = False,
     ) -> LocalRef:
         """Owner-initiated push.  Returns a LocalRef resolving to True/False.
 
@@ -866,11 +880,15 @@ class TransportManager:
         stamped into the frame metadata (``wire.QUANT_GRID_KEY``,
         JSON-encoded) when the payload is integer codes on the round's
         shared grid — see :mod:`rayfed_tpu.fl.quantize`.
+
+        ``blob_offer``: let the object plane replace a large immutable
+        payload with its fingerprint handle (pull-on-demand; see
+        :meth:`send_many`).
         """
         return self.send_many(
             [dest_party], data, upstream_seq_id, downstream_seq_id,
             stream=stream, round_tag=round_tag, epoch_tag=epoch_tag,
-            quant_meta=quant_meta,
+            quant_meta=quant_meta, blob_offer=blob_offer,
         )[dest_party]
 
     def send_many(
@@ -883,6 +901,7 @@ class TransportManager:
         round_tag: Optional[int] = None,
         epoch_tag: Optional[int] = None,
         quant_meta: Optional[Dict[str, Any]] = None,
+        blob_offer: bool = False,
     ) -> Dict[str, LocalRef]:
         """Fan one value out to N parties — encode once, send concurrently.
 
@@ -896,6 +915,16 @@ class TransportManager:
 
         Returns ``{party: LocalRef→bool}`` (one result per destination,
         same swallow-to-False semantics as :meth:`send`).
+
+        ``blob_offer=True`` (the ``fed.get`` broadcast path): when the
+        resolved value is a large immutable object (a plain PackedTree
+        at or above ``JobConfig.blob_broadcast_min_bytes``), the object
+        plane publishes its wire bytes content-addressed and the frame
+        carries the small fingerprint HANDLE instead of the payload
+        (stamped ``wire.BLOB_HANDLE_KEY``); receivers resolve the
+        handle lazily — a content-cache hit transfers zero payload
+        bytes, a miss pulls from this party via BLOB_GET.  See
+        :mod:`rayfed_tpu.transport.objectstore`.
         """
         dests = list(dest_parties)
         out_refs: Dict[str, LocalRef] = {p: LocalRef() for p in dests}
@@ -926,7 +955,19 @@ class TransportManager:
                 )
 
         def _encode_and_send(value: Any) -> None:
+            final_meta = send_meta
             try:
+                if blob_offer:
+                    handle = self.objects.maybe_offer(
+                        value, self._job.blob_broadcast_min_bytes
+                    )
+                    if handle is not None:
+                        # Fingerprint first: the frame ships the small
+                        # handle; the payload moves only for receivers
+                        # that miss the content cache (pull-on-demand).
+                        value = handle
+                        final_meta = dict(send_meta or {})
+                        final_meta[wire.BLOB_HANDLE_KEY] = handle["fp"]
                 t_enc0 = time.perf_counter()
                 bufs = wire.encode_payload(value, lazy_shards=True)
                 if len(dests) > 1:
@@ -983,7 +1024,7 @@ class TransportManager:
                     cf = asyncio.run_coroutine_threadsafe(
                         client.send_data(bufs, str(upstream_seq_id),
                                          str(downstream_seq_id), crc=crc,
-                                         metadata=send_meta,
+                                         metadata=final_meta,
                                          stream=stream,
                                          stream_snapshot=snapshot),
                         self._loop,
@@ -1362,4 +1403,8 @@ class TransportManager:
         # which peers have completed the HELLO key exchange (the
         # operator's "why can't these two mask" diagnostic).
         stats["secagg"] = self.secagg_keys.describe()
+        # Content-addressed object plane: cache hit/miss, pull/serve and
+        # eviction counters (the "did the handle actually save bytes"
+        # diagnostic — also what the rejoin bench gates read).
+        stats["object_plane"] = self.objects.stats_snapshot()
         return stats

@@ -150,6 +150,59 @@ EPOCH_TAG_KEY = "ep"
 # tool/check_wire_format.py.
 QUANT_GRID_KEY = "qg"
 
+# Content-addressed object plane (transport/objectstore.py): the
+# repo's FIRST pull direction.  Three frame-metadata keys, all riding
+# the ordinary per-send "meta" dict — NO frame-layout change, but the
+# key names AND the JSON value schemas (single producers in
+# rayfed_tpu/objects.py) are cross-party contracts, fingerprinted by
+# tool/check_wire_format.py together with OBJECT_PLANE_VERSION.
+#
+# BLOB_GET_KEY — a pull REQUEST frame (tiny, empty payload): the
+# requester asks a holder for the blob whose content fingerprint it
+# was handed, naming the reply rendezvous key the requester is already
+# parked on.  Value: ``objects.make_blob_request`` JSON.
+BLOB_GET_KEY = "bget"
+# BLOB_PUT_KEY — the pull REPLY frame: the holder pushes the stored
+# wire bytes to the requester's reply key (ordinary DATA framing, so
+# per-chunk CRCs, multi-rail striping and the stripe reassembly all
+# apply unchanged), or a payload-less miss notice so the requester
+# fails over to the next named holder instead of waiting out the
+# backstop.  Value: ``objects.make_blob_reply_meta`` JSON.
+BLOB_PUT_KEY = "bput"
+# BLOB_HANDLE_KEY — stamped on a frame whose PAYLOAD is a blob handle
+# offered in place of the object it names (fed.get broadcast of large
+# immutable objects sends the fingerprint first; receivers with a
+# content cache hit never transfer the payload at all).  Value: the
+# bare fingerprint string — receiver logs can attribute the offer
+# without decoding.
+BLOB_HANDLE_KEY = "bhd"
+
+
+def blob_fingerprint(data) -> str:
+    """Content fingerprint of a serialized payload — THE single
+    producer for the object plane's handles (``rayfed_tpu/objects.py``)
+    and for checkpoint metadata stamps.
+
+    Built ON the delta-cache's base-fingerprint machinery rather than
+    beside it: the first field is exactly
+    ``crc_fingerprint(chunk_crcs(data))`` — the same per-chunk-CRC word
+    the per-peer delta cache maintains for its ``bfp`` frames — so a
+    stored blob is directly cross-checkable against delta-cache state,
+    and the chunk-CRC pass is shared work.  A sha256 tail makes the
+    handle collision-resistant as a content ADDRESS (32-bit CRC words
+    alone are fine for desync detection but not for skipping a
+    transfer on fingerprint equality).
+    """
+    import hashlib
+
+    mv = memoryview(data)
+    if mv.format != "B":
+        mv = mv.cast("B")
+    base = crc_fingerprint(chunk_crcs(mv))
+    strong = hashlib.sha256(mv).hexdigest()[:24]
+    return f"b1.{base:08x}.{len(mv):x}.{strong}"
+
+
 # Header key of the connection HELLO handshake carrying the sender's
 # SECURE-AGGREGATION key advertisement (transport/secagg.py): a compact
 # ``"<version>.<kex>.<prg>.<hex key>"`` string — an ephemeral X25519

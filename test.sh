@@ -94,6 +94,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # advanced >= 2 (both corpses dropped without any runtime restart),
 # and coordinator_failovers >= 1 on every survivor (the killed round
 # was re-established at the deterministic successor).
+# OBJECT-PLANE gates (content-addressed pull-on-demand,
+# transport/objectstore.py): rejoin_welcome_bytes_frac <= 0.1 — a
+# WARM welcome-by-handle rejoin (the joiner's content cache already
+# holds the round model, as every quorum participant's does) moves at
+# most 0.1x the eager welcome push's payload bytes (measured ~2e-4:
+# only the fingerprint handle crosses the wire);
+# blob_dedup_single_transfer — 6 concurrent fetches of one
+# fingerprint collapse to exactly ONE BLOB_GET/BLOB_PUT transfer;
+# blob_handle_state_identical — handle-resolved state is
+# BYTE-identical to the eager-push state (receiver-decoded bytes).
 # HIERARCHY gates (traffic-vs-N flatness, fl.hierarchy): at
 # N ∈ {4, 16, 64} in-process virtual parties (2 regions, region rings
 # + quantized cross-region partial-sum streaming), every N must hold

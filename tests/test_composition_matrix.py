@@ -253,10 +253,19 @@ def test_packed_server_opt_requires_packed_wire():
         validate_round_config(PARTIES, server_opt=fedac())
 
 
-def test_join_ticket_excluded_with_server_opt():
-    with pytest.raises(ValueError, match="join_ticket"):
-        validate_round_config(
-            PARTIES, server_opt=fedac(), compress_wire=True,
-            packed_wire=True, quorum=2,
-            join_ticket={"round": 3},
-        )
+def test_join_ticket_composes_with_server_opt():
+    """join_ticket x server_opt was a loud exclusion until the object
+    plane landed: welcomes now carry the server-opt spec plus a content
+    handle to the replicated state, and the joiner resyncs its replica
+    through the pull path.  Bit-exactness verifiers:
+    tests/test_objectstore.py::test_welcome_server_opt_state_roundtrip
+    (the welcome-carried state decodes byte-identical to the
+    coordinator's replica) and the loud spec-mismatch guard
+    tests/test_objectstore.py::test_ticket_server_opt_mismatch_is_loud
+    (fl.quorum._apply_ticket_server_opt names both sides)."""
+    cfg = validate_round_config(
+        PARTIES, server_opt=fedac(), compress_wire=True,
+        packed_wire=True, quorum=2, round_deadline_s=5.0,
+        join_ticket={"round": 3},
+    )
+    assert cfg["server_opt_kind"] == "packed"

@@ -826,6 +826,11 @@ def _run_chaos(party, cluster, outdir):
         _init(wait_ready=False)
         ticket = fed.join(coordinator="alice", timeout=120)
         assert ticket["epoch"] >= 2, ticket  # drop (+1) then rejoin (+1)
+        # Pull-path leg (object plane): the welcome named the model by
+        # content fingerprint, and fed.join resolved it through a
+        # BLOB_GET pull — this fresh runtime's cache was cold, so the
+        # bytes crossed the wire exactly once, by pull not push.
+        assert "model" in ticket, sorted(ticket)
         trainers = _define_trainers(fed, PARTIES4)
         final = run_fedavg_rounds(
             trainers, params, join_ticket=ticket, **kwargs
@@ -833,11 +838,20 @@ def _run_chaos(party, cluster, outdir):
     if party == "carol":
         left_early = len(log) < CHAOS_ROUNDS
 
+    from rayfed_tpu.runtime import get_runtime as _get_rt
+
+    blob_stats = _get_rt().transport.get_stats()["object_plane"]
     with open(os.path.join(outdir, f"{party}.json"), "w") as f:
         json.dump({
             "final": np.asarray(final["w"]).tolist(),
             "round_log": log,
             "left_early": left_early,
+            "blob": {
+                "fetches": blob_stats["blob_fetches"],
+                "fetch_bytes": blob_stats["blob_fetch_bytes"],
+                "serves": blob_stats["blob_serves"],
+                "hits": blob_stats["blob_cache_hits"],
+            },
         }, f)
     fed.shutdown()
 
@@ -892,6 +906,15 @@ def test_quorum_chaos_straggler_crash_rejoin_leave(tmp_path_factory):
     # Epochs advanced without any surviving runtime restarting: drop,
     # rejoin, leave = at least 3 transitions.
     assert by_round[CHAOS_ROUNDS - 1]["epoch"] >= 3, log
+    # Pull-path leg (object plane): the rejoiner resolved its welcome's
+    # model FINGERPRINT by pulling the blob (cold cache → >= 1 fetch
+    # with real bytes), and some holder served it.
+    dave_blob = reports["dave"]["blob"]
+    assert dave_blob["fetches"] >= 1, dave_blob
+    assert dave_blob["fetch_bytes"] > 0, dave_blob
+    assert sum(
+        reports[p]["blob"]["serves"] for p in PARTIES4 if p != "dave"
+    ) >= 1, {p: reports[p]["blob"] for p in PARTIES4}
 
     # Every controller's log agrees with alice's for the rounds it ran
     # (the coordinator's announcements are the one truth; dave's log

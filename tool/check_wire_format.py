@@ -203,6 +203,27 @@ def compute_fingerprint() -> str:
             f"transport/wire.py so this lock fingerprints them"
         )
 
+    # Content-addressed object plane (transport/objectstore.py +
+    # rayfed_tpu/objects.py): the blob handle, the BLOB_GET request and
+    # the BLOB_PUT reply metadata are cross-party contracts riding
+    # ordinary frame metadata / payloads — their schemas and the
+    # OBJECT_PLANE_VERSION knob re-pin this lock WITHOUT a wire bump
+    # (frame layout untouched), like the ring-stripe manifest.  The
+    # three wire.BLOB_*_KEY names also land in frame_metadata_keys
+    # below via the FED006 machinery.
+    from rayfed_tpu import objects as rf_objects
+
+    blob_handle = rf_objects.make_blob_handle(
+        "b1.00000000.10.aa", 16, ["alice", "bob"]
+    )
+    blob_request = rf_objects.make_blob_request(
+        "b1.00000000.10.aa", "blob.put.x.alice.nonce"
+    )
+    blob_reply = rf_objects.make_blob_reply_meta("b1.00000000.10.aa", 16)
+    blob_reply_miss = rf_objects.make_blob_reply_meta(
+        "b1.00000000.10.aa", miss=True
+    )
+
     material = json.dumps(
         {
             "manifest_schema": _schema(manifest),
@@ -256,6 +277,18 @@ def compute_fingerprint() -> str:
                 secagg_recovery_request
             ),
             "secagg_recovery_reply_schema": _schema(secagg_recovery_reply),
+            # Object plane: the pull protocol's metadata keys, the
+            # handle / request / reply schemas, and the protocol
+            # semantics version (what a fingerprint covers, holder
+            # failover rules) — see rayfed_tpu/objects.py.
+            "blob_get_key": wire.BLOB_GET_KEY,
+            "blob_put_key": wire.BLOB_PUT_KEY,
+            "blob_handle_key": wire.BLOB_HANDLE_KEY,
+            "blob_handle_schema": _schema(blob_handle),
+            "blob_request_schema": _schema(blob_request),
+            "blob_reply_schema": _schema(blob_reply),
+            "blob_reply_miss_schema": _schema(blob_reply_miss),
+            "object_plane_version": rf_objects.OBJECT_PLANE_VERSION,
             # Frame-metadata key constants declared in wire.py (*_KEY),
             # extracted by fedlint's FED006 machinery — the same pass
             # that forbids string-literal metadata keys in transport/
