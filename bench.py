@@ -2075,7 +2075,11 @@ def _run_send_path_bench(_party: str, result_q) -> None:
         return t_in - t0, t_end - t_in
 
     do_round(0)  # warmup: connections, codec pools, first fetches
-    log = metrics.get_transfer_log()
+    # The coordinator's PER-MANAGER transfer log (runtime-less child —
+    # the module-global ring no longer sees manager traffic): both the
+    # contributions-in recv records and the broadcast-out send records
+    # are alice's view.
+    log = mgrs["alice"].transfer_log
     total0 = log.total_recorded
     stats0 = mgrs["alice"].get_stats()
     bk0 = stats0["send_path_breakdown_ms"]
@@ -2597,6 +2601,238 @@ def _fill_chaos_extra(extra: dict, res: dict) -> None:
         f"{extra['chaos_coordinator_failovers']} failovers (lease now at "
         f"{extra['chaos_final_coordinator']}), finals "
         f"{'IDENTICAL' if extra['chaos_final_consistent'] else 'DIVERGED'}"
+    )
+
+
+TELEB_PARTIES = ("alice", "bob", "carol", "dave")
+
+
+def _run_telemetry_bench(_party: str, result_q) -> None:
+    """Flight-recorder cost + fidelity (rayfed_tpu/telemetry.py).
+
+    One child, 4 in-process TransportManagers over real loopback
+    sockets (the stream-agg bench's shape), running the SAME
+    streaming-aggregation round in PAIRED disarmed/armed measurements
+    — same warmed caches, same contributions.  Gates (test.sh):
+
+    - ``trace_overhead_frac`` ≤ 0.03 — per-pair armed-vs-disarmed
+      round-wall deltas (pair order swapped every other pair so
+      warm-second bias cancels), gated on the MIN over three 8-pair
+      block medians, within 3%%; an emission is a ring append, so
+      tracing must be ~free and the gate really catches a new
+      sleep/I/O on the hot path;
+    - ``trace_critical_path_agrees`` — the armed rounds' records,
+      collected from every peer manager over the wire
+      (``collect_trace``, the TRACE_GET/TRACE_PUT round trip), merged
+      (clock offsets applied) and fed to ``tool/trace_report``, yield
+      per-round walls that reconcile with the driver's own measured
+      walls within 25%%, and the merged timeline exports as non-empty
+      Perfetto ``trace_event`` JSON.
+    """
+    import numpy as np
+
+    from rayfed_tpu import telemetry
+    from rayfed_tpu.config import ClusterConfig, JobConfig, PartyConfig
+    from rayfed_tpu.fl import compression as fl_comp
+    from rayfed_tpu.fl.streaming import StreamingAggregator
+    from rayfed_tpu.transport.manager import TransportManager
+    from tool.trace_report import round_report
+
+    parties = TELEB_PARTIES
+    ports = {p: 13200 + i for i, p in enumerate(parties)}
+
+    def mk(party):
+        cc = ClusterConfig(
+            parties={
+                p: PartyConfig.from_dict({"address": f"127.0.0.1:{ports[p]}"})
+                for p in parties
+            },
+            current_party=party,
+        )
+        return TransportManager(
+            cc,
+            JobConfig(device_put_received=False, zero_copy_host_arrays=True),
+        )
+
+    mgrs = {p: mk(p) for p in parties}
+    for m in mgrs.values():
+        m.start()
+
+    bundle = fl_comp.compress(_smoke_tree(), packed=True)
+    base32 = np.asarray(bundle.buf).astype(np.float32)
+    n_elems = base32.size
+    wire_dt = np.asarray(bundle.buf).dtype
+
+    def contribution(party_idx: int, r: int):
+        arr = base32.copy()
+        q = n_elems // 4
+        lo = (r % 4) * q
+        arr[lo : lo + q] += 1e-3 * (party_idx + 1) * (r + 1)
+        return fl_comp.PackedTree(
+            arr.astype(wire_dt), bundle.passthrough, bundle.spec
+        )
+
+    peers = [p for p in parties if p != "alice"]
+
+    def do_round(r: int) -> float:
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        contribs = {p: contribution(i + 1, r) for i, p in enumerate(peers)}
+        send_refs = [
+            mgrs[p].send(
+                "alice", contribs[p], f"t{r}-{p}", "0",
+                stream=f"tele/up/{p}", round_tag=r,
+            )
+            for p in peers
+        ]
+        agg = StreamingAggregator(len(parties), party="alice")
+        for i, p in enumerate(peers):
+            mgrs["alice"].recv_stream(p, f"t{r}-{p}", "0", agg.sink(i + 1))
+        agg.add_local(0, contribution(0, r))
+        result = agg.result(timeout=300)
+        bcast = mgrs["alice"].send_many(
+            peers, result, f"tb{r}", "0", stream="tele/down", round_tag=r
+        )
+        for p in peers:
+            out = mgrs[p].recv("alice", f"tb{r}", "0").resolve(timeout=300)
+            np.asarray(out.buf[:64])  # touch: decode really happened
+        for ref in send_refs + list(bcast.values()):
+            if not ref.resolve(timeout=300):
+                raise RuntimeError("telemetry bench send failed")
+        wall = time.perf_counter() - t0
+        # The driver's round record — disarmed this is ONE global read.
+        telemetry.emit(
+            "driver.round", party="alice", round=r, t_start=t0_wall,
+            dur_s=wall, detail={"local_s": 0.0},
+        )
+        return wall
+
+    reps = 7  # the collect/report window size (below)
+    # Overhead probe: the true armed cost is ~µs of ring appends per
+    # round against ~ms loopback/scheduler jitter, so the gate really
+    # asserts "no new sleep/I/O on the hot path" and the estimator
+    # must not let jitter masquerade as overhead.  PAIRED rounds,
+    # order swapped every other pair (within a pair the SECOND round
+    # runs warmer — page cache, branch predictors — so a fixed order
+    # biases one arm; two sequential blocks measured drift as ±9%%
+    # "overhead" against a 3%% gate), and the gate value is the MEDIAN
+    # of the per-pair relative deltas: drift cancels inside each pair,
+    # outlier rounds fall out of the median, and the estimator's noise
+    # shrinks with pair count (~1%% at 24 pairs on the CI box).
+    probe_pairs = 24
+    do_round(0)  # warmup: compiles + seeds every delta cache
+    assert telemetry.installed() is None
+    disarmed = []
+    armed_probe = []
+    r_next = 1
+    for k in range(probe_pairs):
+        if k % 2 == 0:
+            disarmed.append(do_round(r_next))
+            r_next += 1
+            telemetry.install()  # throwaway ring: overhead probe only
+            armed_probe.append(do_round(r_next))
+            r_next += 1
+            telemetry.uninstall()
+        else:
+            telemetry.install()
+            armed_probe.append(do_round(r_next))
+            r_next += 1
+            telemetry.uninstall()
+            disarmed.append(do_round(r_next))
+            r_next += 1
+    deltas = [
+        (a - d) / d for a, d in zip(armed_probe, disarmed)
+    ]
+
+    def _median(xs):
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+    # Gate value = MIN over three independent 8-pair blocks' medians: a
+    # REAL hot-path regression (a sleep or I/O is >= ms on every round)
+    # shifts every block's median, while a scheduler-noise spike must
+    # strike all three blocks at once to masquerade as overhead — the
+    # single 24-pair median still flaked ~3%% right after the full
+    # pytest load's thermal/cache drift.
+    block = len(deltas) // 3
+    overhead_frac = min(
+        _median(deltas[i * block : (i + 1) * block]) for i in range(3)
+    )
+
+    # The collect/report window: ONE persistent recorder across reps
+    # armed rounds — what the cross-manager collection, merge, Perfetto
+    # export and critical-path report run against.
+    telemetry.install()  # party=None: every seam stamps its own party
+    armed_r0 = r_next
+    armed = [do_round(armed_r0 + i) for i in range(reps)]
+
+    # Cross-manager collection over the wire (the TRACE_GET round trip)
+    # from alice against every peer; alice's own window is read locally.
+    me = "alice"
+    rec = telemetry.installed()
+    party_records = {
+        me: [x for x in rec.records() if x.party is None or x.party == me]
+    }
+    offsets = {me: {"offset_s": 0.0, "rtt_s": 0.0, "bound_s": 0.0}}
+    for p in peers:
+        records, offset, rep_meta = mgrs[me].collect_trace(p, timeout_s=60)
+        if not rep_meta["armed"]:
+            raise RuntimeError(f"peer {p} served a disarmed trace window")
+        party_records[p] = records
+        offsets[p] = offset
+    merged = telemetry.merge_records(party_records, offsets)
+    perfetto = telemetry.to_trace_events(merged, offsets)
+    report = round_report(merged, tolerance=0.25)
+
+    agrees = True
+    for i, wall in enumerate(armed):
+        info = report.get(armed_r0 + i)
+        if info is None or not info["wall_agrees"]:
+            agrees = False
+            break
+        if abs(info["wall_s"] - wall) > 0.25 * max(wall, info["wall_s"]):
+            agrees = False
+            break
+    if not perfetto.get("traceEvents"):
+        agrees = False
+
+    spans_from = {
+        str(d.get("party")) for d in merged if d.get("phase") != "driver.round"
+    }
+    stats = rec.stats()
+    telemetry.uninstall()
+    for m in mgrs.values():
+        m.stop()
+    result_q.put((
+        "solo",
+        {
+            "overhead_frac": overhead_frac,
+            "agrees": agrees,
+            "disarmed_wall_s": min(disarmed),
+            "armed_wall_s": min(armed),
+            "merged_records": len(merged),
+            "parties_with_spans": sorted(spans_from),
+            "trace_dropped": stats["trace_dropped"],
+        },
+    ))
+
+
+def _fill_telemetry_extra(extra: dict, s: dict) -> None:
+    extra["trace_overhead_frac"] = round(s["overhead_frac"], 4)
+    extra["trace_critical_path_agrees"] = bool(
+        s["agrees"] and len(s["parties_with_spans"]) == len(TELEB_PARTIES)
+    )
+    extra["trace_merged_records"] = s["merged_records"]
+    extra["trace_dropped"] = s["trace_dropped"]
+    _log(
+        f"  telemetry: armed round wall {s['armed_wall_s'] * 1e3:.1f} ms "
+        f"vs disarmed {s['disarmed_wall_s'] * 1e3:.1f} ms (overhead "
+        f"{100 * s['overhead_frac']:+.2f}%); merged "
+        f"{s['merged_records']} records from "
+        f"{len(s['parties_with_spans'])} parties "
+        f"({s['trace_dropped']} dropped); critical path "
+        f"{'agrees' if extra['trace_critical_path_agrees'] else 'DISAGREES'}"
     )
 
 
@@ -4264,6 +4500,12 @@ def main() -> None:
                 timeout=420,
             )
             _fill_chaos_extra(extra, cres)
+        with _section(extra, "telemetry"):
+            _log("telemetry smoke (flight-recorder overhead armed vs "
+                 "disarmed + cross-manager trace collection / critical-"
+                 "path reconciliation, 4 managers)...")
+            tl = _one_child("_run_telemetry_bench", ndev=1, timeout=420)
+            _fill_telemetry_extra(extra, tl)
         record = {
             "metric": "cross_party_stream_agg_GBps",
             "value": extra.get("cross_party_stream_agg_GBps", 0.0),
@@ -4284,6 +4526,7 @@ def main() -> None:
             or "object_plane_error" in extra
             or "hierarchy_error" in extra
             or "chaos_error" in extra
+            or "telemetry_error" in extra
         ):
             raise SystemExit(1)
         # CI gates (test.sh): aggregation in the compressed domain must
@@ -4500,6 +4743,28 @@ def main() -> None:
                 f"round1_members={extra.get('chaos_round1_members')} "
                 f"epoch={extra.get('chaos_roster_epoch')} "
                 f"failovers={extra.get('chaos_coordinator_failovers')}"
+            )
+            raise SystemExit(1)
+        # CI gates (test.sh): observability must be ~free and honest —
+        # (1) the armed flight-recorder round wall within 3% of the
+        # disarmed wall (an emission is a ring append, never I/O), and
+        # (2) the cross-manager merged trace's per-round critical-path
+        # walls reconcile with the driver's own measured walls (and the
+        # timeline exports as valid Perfetto trace_event JSON, with
+        # spans from every party).
+        tof = extra.get("trace_overhead_frac")
+        if tof is None or tof > 0.03:
+            _log(
+                f"telemetry smoke gate FAILED: trace_overhead_frac="
+                f"{tof} (armed round wall must stay <= 1.03x disarmed)"
+            )
+            raise SystemExit(1)
+        if not extra.get("trace_critical_path_agrees"):
+            _log(
+                "telemetry smoke gate FAILED: the merged trace's per-"
+                "round walls do not reconcile with the driver's "
+                "measured walls (or the Perfetto export / per-party "
+                "span coverage came up empty)"
             )
             raise SystemExit(1)
         return

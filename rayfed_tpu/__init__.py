@@ -40,12 +40,14 @@ from rayfed_tpu.api import (
     join,
     leave,
     set_max_message_length,
+    trace_collect,
+    metrics_snapshot,
 )
 from rayfed_tpu.exceptions import RemoteError
 from rayfed_tpu.fed_object import FedObject
 from rayfed_tpu.metrics import get_stats
 from rayfed_tpu.proxy import send, recv
-from rayfed_tpu import tree_util
+from rayfed_tpu import telemetry, tree_util
 
 __version__ = "0.4.0"
 
@@ -64,5 +66,8 @@ __all__ = [
     "RemoteError",
     "tree_util",
     "get_stats",
+    "trace_collect",
+    "metrics_snapshot",
+    "telemetry",
     "__version__",
 ]

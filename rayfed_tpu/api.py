@@ -70,6 +70,8 @@ def init(
     coordinator_address: Optional[str] = None,
     num_party_processes: Optional[int] = None,
     party_process_id: Optional[int] = None,
+    trace: Optional[bool] = None,
+    trace_capacity: Optional[int] = None,
     **kwargs,
 ) -> Runtime:
     """Initialize this party's controller.
@@ -113,6 +115,14 @@ def init(
 
     _chaos.maybe_install_from_env()
 
+    # Flight recorder (rayfed_tpu/telemetry.py): RAYFED_TRACE=1 arms the
+    # span ring like RAYFED_CHAOS arms faults; an env-armed (or
+    # pre-armed) recorder without a party adopts this one.  The
+    # JobConfig knob arms it below, once job_config exists.
+    from rayfed_tpu import telemetry as _telemetry
+
+    _telemetry.maybe_install_from_env(party=party)
+
     fed_utils.validate_address(address)
     fed_utils.validate_cluster_info(cluster)
 
@@ -155,6 +165,17 @@ def init(
         job_config.peer_health_interval_s = float(peer_health_interval_in_seconds)
     if peer_death_pings is not None:
         job_config.peer_death_pings = int(peer_death_pings)
+    if trace is not None:
+        job_config.trace = bool(trace)
+    if trace_capacity is not None:
+        job_config.trace_capacity = int(trace_capacity)
+    if job_config.trace and _telemetry.installed() is None:
+        _telemetry.install(party=party, capacity=job_config.trace_capacity)
+    elif trace_capacity is not None and _telemetry.installed() is not None:
+        # An env-armed (or test-installed) recorder already exists; an
+        # EXPLICIT capacity request must still take effect — resize in
+        # place (newest records kept) instead of silently ignoring it.
+        _telemetry.installed().resize(int(trace_capacity))
 
     party_group = None
     if coordinator_address is not None:
@@ -282,6 +303,120 @@ def set_max_message_length(max_bytes: int) -> None:
     # The manager also updates runtime.job_config (the same object), so
     # future clients inherit the new cap — one writer, no duplicate here.
     transport.set_max_message_size(int(max_bytes))
+
+
+def trace_collect(
+    rounds: Optional[Any] = None,
+    parties: Optional[List[str]] = None,
+    timeout: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Pull every peer's flight-recorder ring window and merge with the
+    local one into ONE cross-party timeline (``rayfed_tpu.telemetry``).
+
+    ``rounds``: None (whole rings), an int, or an inclusive ``(lo, hi)``
+    range of round tags; records carrying no round tag (mailbox waits,
+    chaos wire faults) are always included.  ``parties``: restrict the
+    peer set (default: every other cluster party).  Peers whose pull
+    fails (dead, unreachable, pre-telemetry build) or whose recorder is
+    disarmed land in ``missing`` with the reason — a partial timeline
+    is returned, never an exception for a single dead peer; ``parties``
+    and ``missing`` are disjoint.  Peers are pulled concurrently, so the
+    collection wall is ~one ``timeout`` even with several peers down.
+
+    Peer clocks are aligned onto THIS party's timeline with the
+    NTP-style offset estimated from each collection round trip (error
+    bound RTT/2, reported per peer in ``clock_offsets`` —
+    :func:`rayfed_tpu.telemetry.estimate_clock_offset`).
+
+    Returns ``{"collector", "records", "clock_offsets", "parties",
+    "missing"}`` where ``records`` is the merged, time-sorted list of
+    record dicts — feed it to
+    :func:`rayfed_tpu.telemetry.to_trace_events` for a Chrome/Perfetto
+    ``trace_event`` JSON export, or to ``tool/trace_report.py`` for a
+    critical-path round report.  Works with the recorder disarmed
+    locally (you still get the peers' windows); multi-host non-leader
+    processes have no wire transport and raise loudly.
+    """
+    from rayfed_tpu import telemetry
+
+    runtime = get_runtime()
+    transport = runtime.transport
+    me = runtime.party
+    if not hasattr(transport, "collect_trace"):
+        raise telemetry.TelemetryError(
+            "this process has no cross-party wire transport to collect "
+            "traces over (multi-host non-leader bridges cannot pull — "
+            "run fed.trace_collect on the party leader)"
+        )
+    rec = telemetry.installed()
+    local = rec.records(rounds=rounds) if rec is not None else []
+    local = [r for r in local if r.party is None or r.party == me]
+    peers = [
+        p for p in (
+            parties if parties is not None
+            else list(runtime.cluster_config.parties)
+        )
+        if p != me
+    ]
+    party_records: Dict[str, list] = {me: local}
+    offsets: Dict[str, Dict[str, float]] = {
+        me: {"offset_s": 0.0, "rtt_s": 0.0, "bound_s": 0.0}
+    }
+    missing: Dict[str, str] = {}
+    # Pull peers CONCURRENTLY: each pull is an independent request/
+    # reply round trip, and a dead/unreachable peer costs its full
+    # per-peer timeout — serialized, N dead peers would stack N
+    # timeouts into the collection wall (exactly the post-chaos
+    # situation this API exists to diagnose).  Concurrent, the wall is
+    # ~one timeout regardless of how many peers are down.
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _pull(p: str):
+        return transport.collect_trace(p, rounds=rounds, timeout_s=timeout)
+
+    if peers:
+        with ThreadPoolExecutor(
+            max_workers=min(len(peers), 8),
+            thread_name_prefix="rayfed-trace-collect",
+        ) as pool:
+            futures = {p: pool.submit(_pull, p) for p in peers}
+            for p in peers:
+                try:
+                    records, offset, rep = futures[p].result()
+                except Exception as exc:
+                    logger.warning(
+                        "[%s] trace collection from %s failed: %r",
+                        me, p, exc,
+                    )
+                    missing[p] = repr(exc)
+                    continue
+                if not rep["armed"] and not records:
+                    # "parties" and "missing" are disjoint by contract:
+                    # a disarmed peer contributed nothing, so it belongs
+                    # in missing ONLY (consumers count parties as
+                    # collected).
+                    missing[p] = "recorder not armed"
+                    continue
+                party_records[p] = records
+                offsets[p] = offset
+    merged = telemetry.merge_records(party_records, offsets)
+    return {
+        "collector": me,
+        "records": merged,
+        "clock_offsets": offsets,
+        "parties": sorted(party_records),
+        "missing": missing,
+    }
+
+
+def metrics_snapshot() -> Dict[str, Any]:
+    """Every subsystem's counters under one documented schema
+    (``rayfed_tpu.metrics.METRICS_SCHEMA``): ``transport``, ``secagg``,
+    ``object_plane``, ``telemetry``, ``quorum``.  See
+    :func:`rayfed_tpu.metrics.metrics_snapshot`."""
+    from rayfed_tpu.metrics import metrics_snapshot as _snapshot
+
+    return _snapshot()
 
 
 def join(coordinator: Optional[str] = None,

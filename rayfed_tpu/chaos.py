@@ -292,6 +292,25 @@ def _apply(rule: _Rule, hook: str, party: Optional[str],
     """Apply a rule's non-sleep effect; returns seconds to sleep (the
     caller sleeps — sync sites block the thread, async sites await)."""
     label = f"chaos[{hook}:{rule.op}]"
+    # Flight recorder: every FIRED fault lands on the same timeline as
+    # the failover/cutoff it causes (rayfed_tpu/telemetry.py) — an
+    # injected partition appears NEXT to the death declaration it
+    # triggered.  Cost: this runs only when a rule actually fires, and
+    # the emit is a nonblocking ring append (standing partitions fire
+    # per frame; their event is ring-bounded like any other record).
+    from rayfed_tpu import telemetry as _telemetry
+
+    _rec = _telemetry.active()
+    if _rec is not None:
+        _rec.emit(
+            f"chaos.{rule.op}", party=party,
+            t_start=time.time(),
+            round=ctx.get("round"), epoch=ctx.get("epoch"),
+            peer=ctx.get("dest", ctx.get("src")),
+            stream=ctx.get("stream"),
+            outcome="injected",
+            detail={"hook": hook, **_ctx_brief(ctx)},
+        )
     if rule.op == "delay_ms":
         delay = rule.delay_s()
         logger.warning("%s party=%s delaying %.0f ms (ctx=%s)",

@@ -22,10 +22,13 @@ import logging
 import os
 import re
 import shutil
+import time
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
+
+from rayfed_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -135,6 +138,7 @@ class FedCheckpointer:
         object plane when one is available; ``meta.json`` carries the
         stamp so :meth:`restore` can resolve the snapshot by CONTENT
         before touching disk."""
+        t0_wall, t0 = time.time(), time.perf_counter()
         host_state = _to_host(state)
         blob_stamp: dict = {}
         plane = self._plane()
@@ -187,6 +191,12 @@ class FedCheckpointer:
         if os.path.exists(old):
             shutil.rmtree(old)
         self._gc()
+        telemetry.emit(
+            "ckpt.save", party=self._party, round=round_num,
+            t_start=t0_wall, dur_s=time.perf_counter() - t0,
+            nbytes=int(blob_stamp.get("blob_n", 0)),
+            detail=blob_stamp or None,
+        )
         logger.info("[%s] checkpoint saved: round %d", self._party, round_num)
 
     def restore(
@@ -210,8 +220,14 @@ class FedCheckpointer:
         # fingerprint stamp BEFORE touching the state files — a cache
         # hit decodes the exact saved bytes from memory (the meta.json
         # stamp is still read from disk: it is what names the content).
+        t0_wall, t0 = time.time(), time.perf_counter()
         cached = self._restore_from_blob(round_num)
         if cached is not None:
+            telemetry.emit(
+                "ckpt.restore", party=self._party, round=round_num,
+                t_start=t0_wall, dur_s=time.perf_counter() - t0,
+                detail={"source": "blob"},
+            )
             return round_num, cached
         path = self._round_dir(round_num)
         if self._use_orbax:
@@ -231,6 +247,11 @@ class FedCheckpointer:
             t_leaves, t_def = jax.tree_util.tree_flatten(target)
             leaves = [data[f"leaf_{i}"] for i in range(len(t_leaves))]
             state = jax.tree_util.tree_unflatten(t_def, leaves)
+        telemetry.emit(
+            "ckpt.restore", party=self._party, round=round_num,
+            t_start=t0_wall, dur_s=time.perf_counter() - t0,
+            detail={"source": "disk"},
+        )
         return round_num, state
 
     def _restore_from_blob(self, round_num: int) -> Optional[Any]:

@@ -180,3 +180,12 @@ class JobConfig:
     # welcomes still work (the coordinator publishes at welcome time;
     # member holders just reply miss → failover).
     blob_publish_round_models: bool = True
+    # Federated flight recorder (rayfed_tpu/telemetry.py): arm the
+    # bounded span ring for this party at fed.init (the RAYFED_TRACE=1
+    # env var arms it too, like RAYFED_CHAOS).  Disarmed, every
+    # emission site costs one module-global read; armed, a span write
+    # is a ring append — never a sleep, never I/O — so tracing adds
+    # ~zero to the round wall (bench-gated: trace_overhead_frac
+    # <= 0.03).  trace_capacity bounds the ring (records, not bytes).
+    trace: bool = False
+    trace_capacity: int = 16384

@@ -658,6 +658,37 @@ class MultiHostTransport:
             return self._inner.objects
         return None
 
+    @property
+    def transfer_log(self):
+        """Per-manager transfer records (rayfed_tpu/metrics.py) — the
+        leader's wire view.  Non-leaders expose their bridge manager's
+        log (its recv re-pushes ARE that process's transfers)."""
+        if self._inner is not None:
+            return self._inner.transfer_log
+        bridge = getattr(self, "_bridge_mgr", None)
+        return getattr(bridge, "transfer_log", None)
+
+    def collect_trace(
+        self, peer: str, rounds=None, timeout_s=None,
+    ) -> tuple:
+        """Cross-party trace pull (``fed.trace_collect``) — leader-only
+        like every cross-party plane: the leader's manager holds the
+        wire clients the TRACE_GET round trip rides.  Non-leaders have
+        no cross-party transport and fail loudly (collect on the
+        leader; the SERVING side works on every process that runs a
+        manager, so multi-host parties can always be collected FROM)."""
+        if self._inner is None:
+            from rayfed_tpu import telemetry
+
+            raise telemetry.TelemetryError(
+                "non-leader process of a multi-host party has no "
+                "cross-party wire transport to collect traces over — "
+                "run fed.trace_collect on the party leader"
+            )
+        return self._inner.collect_trace(
+            peer, rounds=rounds, timeout_s=timeout_s
+        )
+
     def set_max_message_size(self, max_bytes: int) -> None:
         """Runtime message-size cap mutation — NOT supported for
         multi-host parties: the mutation only reaches this process's

@@ -526,6 +526,13 @@ class HierarchyRound:
                 # must STOP the caller unwrapped (the fl.ring contract).
                 raise
             HIER_STATS["rounds_aborted"] += 1
+            from rayfed_tpu import telemetry as _telemetry
+
+            _telemetry.event(
+                "hier.abort", round=self._round_tag, epoch=self._epoch,
+                party=self._me,
+                outcome="error", detail={"error": repr(exc)},
+            )
             if isinstance(exc, HierarchyRoundError):
                 raise
             raise HierarchyRoundError(
@@ -570,6 +577,19 @@ class HierarchyRound:
         coord = lay.coordinators[g]
         is_coord = me == coord
         is_root = me == lay.root
+        from rayfed_tpu import telemetry as _telemetry
+
+        t_mark = t_call0
+        # Flight-recorder hierarchy phase boundaries (region_rs /
+        # region_gather / cross_region / broadcast / commit).
+        # Disarmed: a bare perf_counter read per phase; armed: a ring
+        # append.
+        _phase_span = _telemetry.phase_spanner(
+            "hier", round=self._round_tag, epoch=self._epoch,
+            party=self._me,
+            detail={"region": g, "coordinator": coord, "root": lay.root},
+        )
+
         ce = self._grid.chunk_elems
         total_elems = self._grid.total_elems
         nblocks = packed_block_grid(total_elems, ce)
@@ -589,6 +609,7 @@ class HierarchyRound:
                 s_n,
                 weights=[float(self._iw[p]) for p in region],
                 allowed=self._allowed,
+                party=self._me,
                 chunk_elems=ce,
                 expect_elems=my_se,
                 label=f"region {g} stripe {m}",
@@ -648,6 +669,7 @@ class HierarchyRound:
             raw_stripe = raw.astype(np.dtype(self._ps_dtype))
 
         # -- 2. partial-sum gather to the region coordinator -----------
+        t_mark = _phase_span("region_rs", t_mark)
         _maybe_fault("ps", me)
         if not is_coord:
             if raw_stripe is not None:
@@ -702,6 +724,7 @@ class HierarchyRound:
                     )
                 scatter(arr, stripes[k])
 
+        t_mark = _phase_span("region_gather", t_mark)
         # -- 3. region sums stream to the root --------------------------
         _maybe_fault("up", me)
         result = None
@@ -730,6 +753,7 @@ class HierarchyRound:
                     len(lay.active),
                     weights=[float(totals[j]) for j in lay.active],
                     allowed=self._allowed,
+                    party=self._me,
                     chunk_elems=ce,
                     quant=self._grid,
                     quant_ref=self._qref,
@@ -750,6 +774,7 @@ class HierarchyRound:
                 root_agg.add_local(lay.active.index(g), region_sum)
                 result = root_agg.result(timeout=backstop)
 
+        t_mark = _phase_span("cross_region", t_mark)
         # -- 4. broadcast down the tree ---------------------------------
         _maybe_fault("down", me)
         down_descr = None
@@ -829,6 +854,7 @@ class HierarchyRound:
         # abort is a lockstep verdict.  Like any atomic commit, a crash
         # inside the tiny release pass itself can strand waiters until
         # the backstop; the bulk phases are fully covered.
+        t_mark = _phase_span("broadcast", t_mark)
         _maybe_fault("commit", me)
         token = {"ok": 1}
         if is_root:
@@ -881,6 +907,7 @@ class HierarchyRound:
             self._recv(
                 coord, f"{release_id}.r", release_id
             ).resolve(timeout=backstop)
+        _phase_span("commit", t_mark)
         return result
 
     def _decode_down(self, value: Any) -> PackedTree:

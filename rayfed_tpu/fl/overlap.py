@@ -215,6 +215,10 @@ class PipelinedRoundRunner:
         self._stream = stream
         self._on_round = on_round
         self._ring_chunk_elems = ring_chunk_elems
+        # The local controller's party — set by run() (the runtime is
+        # not required at construction time); stamps the flight
+        # recorder's driver.round / overlap.hidden spans.
+        self._me: Optional[str] = None
 
     # -- lane-side: one round's push + aggregate (+ fallback) ----------------
 
@@ -328,6 +332,7 @@ class PipelinedRoundRunner:
         t_round0 = rec.pop("_t0", None)
         lane_t0 = rec.pop("_lane_t0", None)
         lane_t1 = rec.pop("_lane_t1", None)
+        start = None
         if lane_t0 is not None and lane_t1 is not None:
             # My contribution resolved before the aggregate could land,
             # so the local_s callback has fired by now.  The window can
@@ -362,6 +367,35 @@ class PipelinedRoundRunner:
             rec.get("push_s", 0.0), rec.get("agg_s", 0.0),
             rec["hidden_s"],
         )
+        from rayfed_tpu import telemetry as _telemetry
+
+        _tr = _telemetry.active()
+        if _tr is not None and lane_t1 is not None:
+            # The honest round record as a span, plus the overlap's
+            # hidden-comms window — the stretch of round k's comms that
+            # ran UNDER round k+1's train.  Wall anchors derive from
+            # the perf-counter marks relative to now (the ring append
+            # itself never blocks the lane).
+            now_p, now_w = time.perf_counter(), time.time()
+            anchor = t_round0 if t_round0 is not None else lane_t0
+            _tr.emit(
+                "driver.round", round=inflight.round_index,
+                party=self._me, peer=self._coord,
+                t_start=now_w - (now_p - anchor),
+                dur_s=max(0.0, lane_t1 - anchor),
+                detail={
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in rec.items()
+                },
+            )
+            if start is not None and rec["hidden_s"] > 0.0:
+                _tr.emit(
+                    "overlap.hidden", round=inflight.round_index,
+                    party=self._me,
+                    t_start=now_w - (now_p - start),
+                    dur_s=rec["hidden_s"],
+                    detail={"agg_s": round(rec["agg_s"], 6)},
+                )
         return agg
 
     def run(
@@ -388,6 +422,7 @@ class PipelinedRoundRunner:
 
         runtime = get_runtime()
         me = runtime.party
+        self._me = me
         backstop = runtime.job_config.recv_backstop_s
         parties = list(self._trainers)
         outgoing = compress(params, packed=True, wire_dtype=self._wire_dtype)
@@ -400,9 +435,14 @@ class PipelinedRoundRunner:
             prev_contribs: Optional[Dict[str, Any]] = None
             inflight: Optional[_InFlight] = None
             for r in range(rounds):
-                rec: Dict[str, float] = {
+                rec: Dict[str, Any] = {
                     "local_s": 0.0, "push_s": 0.0, "agg_s": 0.0,
                     "hidden_s": 0.0,
+                    # Correlation stamp (flight recorder): the same
+                    # keys the transport rides on every frame, so this
+                    # row joins the wire's view of its round.  The
+                    # overlap runner has no roster epoch.
+                    "round": r, "epoch": None, "coordinator": self._coord,
                 }
                 t_r0 = time.perf_counter()
                 rec["_t0"] = t_r0  # popped by _collect

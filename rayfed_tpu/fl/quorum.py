@@ -82,7 +82,7 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from rayfed_tpu import chaos
+from rayfed_tpu import chaos, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -449,6 +449,7 @@ def quorum_aggregate(
         allowed=runtime.cluster_config.serializing_allowed_list,
         quorum=min(int(quorum), len(parties)),
         labels=parties,
+        party=me,
         quant=quant,
         **agg_kwargs,
     )
@@ -1014,10 +1015,16 @@ def run_quorum_rounds(
             sopt.ensure(x_srv)
             step_fn = sopt.step_fn(x_srv)
         rec = None
-        if timings is not None:
+        # Flight recorder: armed, every round emits a driver-side span
+        # carrying the SAME round/epoch keys the transport stamps on
+        # frames (rayfed_tpu/telemetry.py) — the driver's view and the
+        # wire's view join on one timeline.
+        trace_round = telemetry.armed()
+        if timings is not None or trace_round:
             rec = {"local_s": 0.0, "push_s": 0.0, "agg_s": 0.0,
                    "hidden_s": 0.0}
             t_r0 = time.perf_counter()
+            t_r0_wall = time.time()
         inputs = {p: late_inputs.pop(p, current) for p in active}
         updates = {
             p: trainers[p].train.remote(inputs[p]) for p in active
@@ -1089,6 +1096,14 @@ def run_quorum_rounds(
                         f"{active} (dead: {sorted(dead)})"
                     ) from exc
                 QUORUM_STATS["coordinator_failovers"] += 1
+                telemetry.event(
+                    "quorum.failover", round=r, epoch=epoch,
+                    party=me, peer=successor, outcome="failover",
+                    detail={
+                        "from": coord, "to": successor,
+                        "dead": sorted(dead), "error": repr(exc),
+                    },
+                )
                 logger.warning(
                     "[%s] round %d: coordinator %s declared dead (%s); "
                     "failing over to successor %s and re-establishing "
@@ -1127,9 +1142,26 @@ def run_quorum_rounds(
                 # announcement that drops the leaver from the roster.
                 next_coord = str(handover)
                 QUORUM_STATS["graceful_handovers"] += 1
+                telemetry.event(
+                    "quorum.handover", round=r, epoch=epoch,
+                    party=me, peer=next_coord,
+                    detail={"from": coord, "to": next_coord},
+                )
                 logger.info(
                     "[%s] round %d: coordinator %s handed the lease to "
                     "%s", me, r, coord, next_coord,
+                )
+            # Guarded (not just event()'s internal check): this fires
+            # EVERY round, and disarmed cost is one global read — the
+            # sorted()/detail construction must not run untraced.
+            if telemetry.active() is not None:
+                telemetry.event(
+                    "quorum.announce", round=r, party=me, peer=coord,
+                    epoch=int(outcome.announce["epoch"]),
+                    detail={
+                        "members": sorted(outcome.announce["members"]),
+                        "handover": handover,
+                    },
                 )
         log.append({
             "round": r, "epoch": epoch, "active": list(active),
@@ -1173,7 +1205,24 @@ def run_quorum_rounds(
             rec["agg_s"] = max(
                 0.0, rec.get("agg_s", 0.0) - rec["local_s"]
             )
-            timings.append(rec)
+            # Correlation stamp: the keys the transport rides on every
+            # frame, so a timings row joins the wire's view of its
+            # round — plus the quorum facts the classic loop lacks.
+            rec["round"] = r
+            rec["epoch"] = epoch
+            rec["coordinator"] = coord
+            if timings is not None:
+                timings.append(rec)
+            if trace_round:
+                telemetry.emit(
+                    "driver.round", round=r, epoch=epoch, party=me,
+                    peer=coord, t_start=t_r0_wall,
+                    dur_s=time.perf_counter() - t_r0,
+                    detail={
+                        k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in rec.items()
+                    } | {"members": sorted(members)},
+                )
         if on_round is not None:
             on_round(r, decompress(current))
         if me == coord and outcome.welcomes:

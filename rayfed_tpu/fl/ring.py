@@ -398,9 +398,18 @@ def ring_aggregate(
         rs_id, ag_id, commit_id, release_id, nm_id = seq_ids
     import time as _time
 
-    t_call0 = _time.perf_counter()
+    from rayfed_tpu import telemetry as _telemetry
 
+    t_call0 = _time.perf_counter()
+    t_mark = t_call0
     me = runtime.party
+    # Flight-recorder ring phase boundaries (reduce_scatter /
+    # all_gather / commit).  Disarmed: a bare perf_counter read per
+    # phase; armed: a ring append — never I/O.
+    _phase_span = _telemetry.phase_spanner(
+        "ring", round=round_tag, party=me,
+    )
+
     backstop = (
         timeout if timeout is not None
         else runtime.job_config.recv_backstop_s
@@ -675,6 +684,10 @@ def ring_aggregate(
             my_reduced = agg.result(timeout=backstop)
         else:
             my_reduced = np.empty(0, out_dt)
+        t_mark = _phase_span(
+            "reduce_scatter", t_mark,
+            detail={"stripe": m, "parties": n},
+        )
 
         # Reduced passthrough: stripe 0's owner always exists (block 0
         # is always in stripe 0) and holds every party's non-float
@@ -820,6 +833,7 @@ def ring_aggregate(
                     f"all-gather forward of stripe {k} (hop {hop}) to "
                     f"{succ!r} failed"
                 )
+        t_mark = _phase_span("all_gather", t_mark)
 
         # -- assemble the full buffer back onto the chunk grid ---------
         full = np.empty(total_elems, out_dt)
@@ -895,6 +909,10 @@ def ring_aggregate(
             # the trainer's fallback swallow it and keep training.
             raise
         RING_STATS["rounds_aborted"] += 1
+        _telemetry.event(
+            "ring.abort", round=round_tag, party=me, outcome="error",
+            detail={"error": repr(exc)},
+        )
         if isinstance(exc, RingRoundError):
             raise
         raise RingRoundError(f"ring round aborted: {exc!r}") from exc
@@ -906,6 +924,7 @@ def ring_aggregate(
             logger.exception("[%s] non-member release pass failed", me)
     _quant_commit()
     RING_STATS["rounds_completed"] += 1
+    _phase_span("commit", t_mark)
     if timings is not None:
         timings.setdefault("push_s", 0.0)
         timings["agg_s"] = _time.perf_counter() - t_call0
@@ -921,6 +940,7 @@ def _make_stripe_agg(runtime, n_sources, weights, out_dtype, expect_elems,
         n_sources,
         weights=weights,
         allowed=runtime.cluster_config.serializing_allowed_list,
+        party=runtime.party,
         # The fold grid must match the stripe compaction grid, or an
         # overridden granularity would fold in 4 MB units only (no
         # streaming overlap) and over-allocate the accumulator.

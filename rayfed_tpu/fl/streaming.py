@@ -173,9 +173,16 @@ class StreamingAggregator:
         masked: bool = False,
         mask_recovery: Optional[Any] = None,
         presummed: Optional[str] = None,
+        party: Optional[str] = None,
     ) -> None:
         if n_sources < 1:
             raise ValueError("streaming aggregation needs >= 1 source")
+        # Acting party for flight-recorder spans (agg.fold/finalize,
+        # quorum.cutoff).  In-process multi-party runs share ONE
+        # process-global recorder, so an unstamped record would be
+        # served by EVERY manager's trace window and the merged
+        # timeline would duplicate it under each party's clock offset.
+        self._party = None if party is None else str(party)
         if quorum is not None and not 1 <= int(quorum) <= n_sources:
             raise ValueError(
                 f"quorum must be in [1, {n_sources}], got {quorum}"
@@ -675,6 +682,16 @@ class StreamingAggregator:
             "(excluded: %s); reweighting to the arrived sum",
             len(ready), self._n, excluded,
         )
+        from rayfed_tpu import telemetry
+
+        telemetry.event(
+            "quorum.cutoff",
+            party=self._party,
+            detail={
+                "members": [self._labels[i] for i in ready],
+                "excluded": excluded,
+            },
+        )
         if self._weights_arg is not None:
             from rayfed_tpu.fl.fedavg import _check_weights
 
@@ -1048,13 +1065,45 @@ class StreamingAggregator:
                     s.applied_blocks = hi
 
         t0 = time.perf_counter()
+        t0_wall = time.time()
         result = self._finalize()
-        self._busy_s += time.perf_counter() - t0
+        fin_s = time.perf_counter() - t0
+        self._busy_s += fin_s
         self._t_done = time.perf_counter()
         if not self._t_all_complete:
             self._t_all_complete = self._t_done
         tail_s = max(0.0, self._t_done - self._t_all_complete)
         busy = max(self._busy_s, 1e-9)
+        from rayfed_tpu import telemetry as _telemetry
+
+        _tr = _telemetry.active()
+        if _tr is not None:
+            # The fold window (first byte → every block folded) and the
+            # single finalize, as spans.  Wall anchors derive from the
+            # perf-counter marks relative to now.
+            now_p, now_w = time.perf_counter(), time.time()
+            if self._t_first_byte:
+                _tr.emit(
+                    "agg.fold",
+                    party=self._party,
+                    t_start=now_w - (now_p - self._t_first_byte),
+                    dur_s=max(0.0, self._t_all_complete
+                              - self._t_first_byte),
+                    detail={
+                        "busy_ms": round(self._busy_s * 1e3, 3),
+                        "parties": len(self._streams),
+                    },
+                )
+            _tr.emit(
+                "agg.finalize", party=self._party,
+                t_start=t0_wall, dur_s=fin_s,
+                detail={
+                    "excluded": (
+                        0 if self._participating is None
+                        else self._n - len(self._participating)
+                    ),
+                },
+            )
         self.stats = {
             "agg_busy_s": self._busy_s,
             "agg_tail_s": tail_s,
@@ -1284,10 +1333,12 @@ class StripeAggregator(StreamingAggregator):
         quant: Optional[Any] = None,
         quant_blocks: Optional[Sequence[int]] = None,
         quant_ref: Optional[Any] = None,
+        party: Optional[str] = None,
     ) -> None:
         super().__init__(
             n_sources, weights=weights, allowed=allowed,
             chunk_elems=chunk_elems, out_dtype=out_dtype,
+            party=party,
             quant=quant,
             # The stripe's compacted slice of the shared reference (the
             # base-class size check against the FULL grid is skipped
@@ -1644,6 +1695,7 @@ def streaming_aggregate(
         weights=weights,
         allowed=runtime.cluster_config.serializing_allowed_list,
         out_dtype=out_dtype,
+        party=me,
         quant=quant,
         quant_ref=qref,
         masked=secagg is not None,

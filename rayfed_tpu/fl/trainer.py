@@ -875,9 +875,18 @@ def run_fedavg_rounds(
     me = None
     sa_keys = None
     sa_session = None
-    if timings is not None:
+    # Flight recorder (rayfed_tpu/telemetry.py): armed, every
+    # materialized round emits driver-side spans carrying the SAME
+    # round/epoch keys the transport stamps on frames, so the driver's
+    # view and the wire's view join on one timeline.  The lazy pipelined
+    # path stays untraced (no per-round boundary), exactly like
+    # ``timings``.
+    from rayfed_tpu import telemetry as _telemetry
+
+    trace_rounds = _telemetry.armed() and not pipeline
+    if timings is not None or trace_rounds:
         import time as _time
-    if timings is not None or secure_agg:
+    if timings is not None or secure_agg or trace_rounds:
         from rayfed_tpu.runtime import get_runtime
 
         _rt = get_runtime()
@@ -919,7 +928,7 @@ def run_fedavg_rounds(
         else:
             outgoing = current
         rec = None
-        if timings is not None:
+        if timings is not None or trace_rounds:
             # Per-round breakdown (satellite of the overlap work): the
             # synchronous path exposes local/push/agg walls with
             # hidden_s pinned at 0 — comms fully serialize behind
@@ -929,6 +938,7 @@ def run_fedavg_rounds(
                 "hidden_s": 0.0,
             }
             t_r0 = _time.perf_counter()
+            t_r0_wall = _time.time()
         updates = [trainers[p].train.remote(outgoing) for p in active]
         if rec is not None and me in active:
             my_ref = updates[active.index(me)].get_local_ref()
@@ -1217,7 +1227,25 @@ def run_fedavg_rounds(
             # only window (what overlap=True would hide).
             rec["push_s"] = max(0.0, rec["push_s"] - rec["local_s"])
             rec["agg_s"] = max(0.0, rec["agg_s"] - rec["local_s"])
-            timings.append(rec)
+            # Correlation stamp: the SAME keys the transport rides on
+            # every frame (wire.ROUND_TAG_KEY / EPOCH_TAG_KEY), so a
+            # timings row joins the wire's view of its round on one
+            # timeline.  Classic fedavg has no roster epoch — None.
+            rec["round"] = r
+            rec["epoch"] = None
+            rec["coordinator"] = coord
+            if timings is not None:
+                timings.append(rec)
+            if trace_rounds:
+                _telemetry.emit(
+                    "driver.round", round=r, party=me, peer=coord,
+                    t_start=t_r0_wall,
+                    dur_s=_time.perf_counter() - t_r0,
+                    detail={
+                        k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in rec.items()
+                    },
+                )
             logger.debug(
                 "round %d timings: local=%.3fs push=%.3fs agg=%.3fs "
                 "hidden=%.3fs", r, rec["local_s"], rec["push_s"],

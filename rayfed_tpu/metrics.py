@@ -3,19 +3,29 @@
 The reference keeps only two op counters on its proxy actors
 (``_stats["send_op_count"]`` / ``_stats["receive_op_count"]``,
 ``barriers.py:200,296``) exposed via ``_get_stats``.  Here observability
-is a real subsystem:
+is a real subsystem, in three layers:
 
-- :func:`get_stats` — aggregate runtime stats (op counts, bytes,
-  seconds, effective GB/s, pending recvs, crc errors) from the party's
-  transport; superset of the reference's counters.
-- :class:`TransferLog` — optional per-transfer records (peer, seq ids,
-  bytes, seconds) with a bounded ring buffer, for the GB/s north-star
-  analysis.
-- :func:`trace_span` — ``jax.profiler.TraceAnnotation`` context manager
-  so framework phases (encode/send/recv/decode, fedavg rounds) show up
-  on TPU profiler timelines.
-- :func:`start_profile` / :func:`stop_profile` — thin wrappers over
-  ``jax.profiler`` trace capture.
+- **counters** — :func:`get_stats` (aggregate runtime stats: op counts,
+  bytes, seconds, effective GB/s, pending recvs, crc errors, the
+  send-path stage breakdown, plus the ``secagg`` / ``object_plane`` /
+  ``telemetry`` sections) and :func:`metrics_snapshot`, which gathers
+  every subsystem's counters under ONE documented schema
+  (:data:`METRICS_SCHEMA` — schema drift fails CI the way wire drift
+  does, see ``tests/test_telemetry.py``);
+- **per-transfer records** — :class:`TransferLog`, a bounded ring of
+  (peer, seq ids, bytes, seconds) per transfer.  One log lives on each
+  ``TransportManager`` (``transport.transfer_log``) so in-process
+  multi-party tests/benches don't conflate parties;
+  :func:`get_transfer_log` resolves the current runtime's log and
+  keeps the module-global ring only as a documented runtime-less
+  fallback;
+- **span traces** — the federated flight recorder
+  (:mod:`rayfed_tpu.telemetry`): structured cross-party span/event
+  records, merged timelines (Perfetto export), and critical-path round
+  reports (``tool/trace_report.py``).  :func:`trace_span` /
+  :func:`start_profile` / :func:`stop_profile` remain the thin
+  ``jax.profiler`` hooks for on-device (XLA) timelines — the flight
+  recorder covers the cross-party protocol layer those never see.
 """
 
 from __future__ import annotations
@@ -86,10 +96,27 @@ class TransferLog:
         return sum(r.nbytes for r in recs) / sum(r.seconds for r in recs) / 1e9
 
 
+# Runtime-less fallback ONLY: every TransportManager owns its own
+# TransferLog (``transport.transfer_log``), so in-process multi-party
+# tests/benches record each party's transfers into its own ring.  This
+# module-global ring is what :func:`get_transfer_log` returns when no
+# runtime (or no transport) exists in the process — e.g. unit tests of
+# the log itself.
 _global_transfer_log = TransferLog()
 
 
 def get_transfer_log() -> TransferLog:
+    """The CURRENT runtime's per-manager transfer log, falling back to
+    the documented module-global ring when no runtime/transport exists.
+
+    In-process simulations holding several managers should read each
+    manager's ``transfer_log`` attribute directly — this accessor is
+    the one-party (one runtime per process) convenience."""
+    runtime = get_runtime_or_none()
+    transport = getattr(runtime, "transport", None)
+    log = getattr(transport, "transfer_log", None)
+    if log is not None:
+        return log
     return _global_transfer_log
 
 
@@ -106,6 +133,74 @@ def get_stats() -> Dict[str, Any]:
     secs = stats.get("send_seconds", 0.0)
     stats["send_gbps"] = (stats.get("send_bytes", 0) / secs / 1e9) if secs else 0.0
     return stats
+
+
+# The documented shape of :func:`metrics_snapshot`: section → {key →
+# type}.  A key listed here MUST exist in the section with that type —
+# ``tests/test_telemetry.py::test_metrics_snapshot_schema`` asserts it,
+# so renaming/retyping a counter fails CI the way wire-format drift
+# does.  Sections may carry ADDITIONAL keys freely; only removals and
+# retypes of the documented surface break the contract.
+METRICS_SCHEMA: Dict[str, Dict[str, type]] = {
+    "transport": {
+        "send_op_count": int,
+        "send_bytes": int,
+        "send_seconds": float,
+        "send_gbps": float,
+        "pending_recvs": int,
+        "send_path_breakdown_ms": dict,
+        "delta_bytes_saved_frac": float,
+        "send_dest_seconds": dict,
+        "dead_parties": list,
+    },
+    "secagg": {
+        "kex": str,
+        "prg": str,
+        "peers": dict,
+    },
+    "object_plane": {
+        "blob_cache_hits": int,
+        "blob_cache_misses": int,
+        "blob_fetches": int,
+        "blob_fetch_bytes": int,
+        "blob_serves": int,
+        "blob_cache_bytes": int,
+        "blob_pinned_bytes": int,
+    },
+    "quorum": {
+        "coordinator_failovers": int,
+        "graceful_handovers": int,
+    },
+    "telemetry": {
+        "trace_armed": bool,
+    },
+}
+
+
+def metrics_snapshot() -> Dict[str, Any]:
+    """Every subsystem's counters under ONE documented schema
+    (:data:`METRICS_SCHEMA`): ``transport`` (the :func:`get_stats`
+    surface), ``secagg`` / ``object_plane`` / ``telemetry`` (hoisted
+    from their get_stats sections), and ``quorum``
+    (``fl.quorum.QUORUM_STATS``, which lives per process, not on the
+    transport).  Returns ``{}`` before ``fed.init`` — a snapshot of
+    nothing is not an error."""
+    stats = get_stats()
+    if not stats:
+        return {}
+    from rayfed_tpu.fl.quorum import QUORUM_STATS
+
+    out: Dict[str, Any] = {
+        "transport": {
+            k: v for k, v in stats.items()
+            if k not in ("secagg", "object_plane", "telemetry")
+        },
+        "secagg": dict(stats.get("secagg") or {}),
+        "object_plane": dict(stats.get("object_plane") or {}),
+        "telemetry": dict(stats.get("telemetry") or {}),
+        "quorum": dict(QUORUM_STATS),
+    }
+    return out
 
 
 @contextlib.contextmanager

@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from rayfed_tpu import chaos
+from rayfed_tpu import telemetry
 from rayfed_tpu.config import RetryPolicy
 from rayfed_tpu.transport import wire
 
@@ -739,7 +740,26 @@ class TransportClient:
         self.stats["send_d2h_s"] += d2h_s
         self.stats["send_crc_s"] += crc_s
         self.stats["send_socket_s"] += write_s
-        self.stats["send_frame_wall_s"] += time.perf_counter() - t_frame0
+        frame_wall = time.perf_counter() - t_frame0
+        self.stats["send_frame_wall_s"] += frame_wall
+        _tr = telemetry.active()
+        if _tr is not None:
+            # The PR 5 send-path stage breakdown as a SPAN: one record
+            # per payload frame with where its wall actually went
+            # (device→host fetch, checksum, socket) — what get_stats'
+            # cumulative {encode,d2h,crc,loop_wait,socket}_ms can only
+            # show summed over the whole session.  Ring append only —
+            # this coroutine runs on the transport loop.
+            _tr.emit(
+                "wire.frame", party=self._src_party,
+                peer=self._dest_party, nbytes=payload_nbytes,
+                t_start=time.time() - frame_wall, dur_s=frame_wall,
+                detail={
+                    "d2h_ms": round(d2h_s * 1e3, 3),
+                    "crc_ms": round(crc_s * 1e3, 3),
+                    "socket_ms": round(write_s * 1e3, 3),
+                },
+            )
 
     def _dest_known_dead(self) -> bool:
         """True while the health monitor has the destination declared
@@ -1547,6 +1567,25 @@ class TransportClient:
                     self.stats["delta_full_frames"] += 1
                 else:
                     self.stats["delta_stream_frames"] += 1
+                _tr = telemetry.active()
+                if _tr is not None:
+                    # Delta-cache verdict for THIS stream send: how many
+                    # of the payload's chunks the diff kept off the wire
+                    # (a "full" outcome is a cold stream or a re-seed
+                    # after a base desync).  Ring append only — loop
+                    # coroutine.
+                    _tr.emit(
+                        "wire.delta", party=self._src_party,
+                        peer=self._dest_party, stream=stream,
+                        nbytes=wire_bytes,
+                        outcome="full" if force_full else "delta",
+                        detail={
+                            "logical_bytes": total,
+                            "changed_chunks": (
+                                None if force_full else len(changed)
+                            ),
+                        },
+                    )
                 return ack.get("result", "OK")
             raise SendError(
                 f"stream send to {self._dest_party} failed after "

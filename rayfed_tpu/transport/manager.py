@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from rayfed_tpu import telemetry
 from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
 from rayfed_tpu.executor import LocalRef
 from rayfed_tpu.transport import secagg as secagg_keys
@@ -341,6 +342,23 @@ class TransportManager:
             self, budget_bytes=job_config.blob_cache_budget_bytes
         )
         self._server._observers.append(self.objects._observe_request)
+        # Per-manager transfer log (rayfed_tpu/metrics.py): in-process
+        # multi-party tests/benches used to conflate every party's
+        # transfers into the module-global ring (the KeyAgreement
+        # per-manager lesson from the secagg work) — each manager now
+        # owns its ring; the module global remains a documented
+        # runtime-less fallback.
+        from rayfed_tpu import metrics as _metrics
+
+        self.transfer_log = _metrics.TransferLog()
+        # Flight-recorder trace collection (rayfed_tpu/telemetry.py):
+        # peers pull this party's span-ring window via a TRACE_GET
+        # request frame consumed by a server observer — the BLOB_GET
+        # shape — answered with a JSON record window on the requester's
+        # nonce reply key.  Serving works even with the recorder
+        # disarmed (an empty window, marked armed=False), so a mixed
+        # fleet degrades loudly rather than hanging the collector.
+        self._server._observers.append(self._observe_trace_request)
         # Set by api.init: () -> Optional[jax.sharding.Mesh].  Received
         # shard-encoded leaves whose sender sharding fits this mesh are
         # device_put with the equivalent local NamedSharding.
@@ -1045,17 +1063,23 @@ class TransportManager:
                             self._dest_seconds.get(p, 0.0) + dt
                         )
                         self._dest_ops[p] = self._dest_ops.get(p, 0) + 1
+                    _tr = telemetry.active()
                     try:
                         f.result()
                         self._peers_acked.add(p)
                         self.stats["send_bytes"] += nbytes
                         self.stats["send_seconds"] += dt
-                        from rayfed_tpu import metrics
-
-                        metrics.get_transfer_log().record(
+                        self.transfer_log.record(
                             "send", p, upstream_seq_id,
                             downstream_seq_id, nbytes, dt,
                         )
+                        if _tr is not None:
+                            _tr.emit(
+                                "wire.send", party=self._party, peer=p,
+                                stream=stream, nbytes=nbytes,
+                                t_start=time.time() - dt, dur_s=dt,
+                                round=round_tag, epoch=epoch_tag,
+                            )
                         out_refs[p].set_result(True)
                     except Exception as e:
                         logger.warning(
@@ -1065,6 +1089,15 @@ class TransportManager:
                             "" if round_tag is None
                             else f" round={round_tag}", e,
                         )
+                        if _tr is not None:
+                            _tr.emit(
+                                "wire.send", party=self._party, peer=p,
+                                stream=stream, nbytes=nbytes,
+                                t_start=time.time() - dt, dur_s=dt,
+                                round=round_tag, epoch=epoch_tag,
+                                outcome="error",
+                                detail={"error": repr(e)},
+                            )
                         out_refs[p].set_result(False)
 
                 cf.add_done_callback(_done)
@@ -1104,6 +1137,7 @@ class TransportManager:
         allowed = self._cluster.serializing_allowed_list
         device_put = self._job.device_put_received
 
+        t_req = time.time()
         cf = asyncio.run_coroutine_threadsafe(
             self._mailbox.get(
                 str(upstream_seq_id),
@@ -1117,8 +1151,44 @@ class TransportManager:
             ),
             self._loop,
         )
+        # Delivery timestamp for the mailbox.wait span: _decode runs on
+        # the codec pool AFTER a queue hop, so stamping inside it would
+        # bill decode-pool backlog as "the peer had not pushed yet" —
+        # exactly the misattribution the recorder exists to prevent.
+        t_delivered: list = []
+        if telemetry.active() is not None:
+            cf.add_done_callback(lambda _f: t_delivered.append(time.time()))
 
         def _decode(message: Message) -> Any:
+            _tr = telemetry.active()
+            if _tr is not None:
+                # The mailbox park (request → delivery) and the socket-
+                # read wall are the receiver's two waits: the first is
+                # "the peer had not pushed yet", the second "the bytes
+                # were in flight".  Round/epoch attribution rides the
+                # frame's own metadata tags.
+                meta = message.metadata or {}
+                rnd = meta.get(wire.ROUND_TAG_KEY)
+                ep = meta.get(wire.EPOCH_TAG_KEY)
+                kw = dict(
+                    party=self._party, peer=message.src_party,
+                    stream=str(upstream_seq_id),
+                    round=int(rnd) if rnd is not None else None,
+                    epoch=int(ep) if ep is not None else None,
+                    outcome="error" if message.error is not None else "ok",
+                )
+                now = t_delivered[0] if t_delivered else time.time()
+                _tr.emit(
+                    "mailbox.wait", t_start=t_req,
+                    dur_s=max(0.0, now - t_req), **kw,
+                )
+                if message.error is None:
+                    _tr.emit(
+                        "wire.read",
+                        t_start=now - float(message.read_seconds or 0.0),
+                        dur_s=float(message.read_seconds or 0.0),
+                        nbytes=len(message.payload), **kw,
+                    )
             if message.error is not None:
                 from rayfed_tpu.exceptions import RemoteError
 
@@ -1131,11 +1201,9 @@ class TransportManager:
                 mesh=mesh,
                 zero_copy=self._job.zero_copy_host_arrays,
             )
-            from rayfed_tpu import metrics
-
             # Denominator = socket-read wall time (honest wire GB/s
             # at the receiver); decode runs here but is not billed.
-            metrics.get_transfer_log().record(
+            self.transfer_log.record(
                 "recv", message.src_party, upstream_seq_id,
                 downstream_seq_id, len(message.payload),
                 message.read_seconds,
@@ -1340,6 +1408,240 @@ class TransportManager:
                 )
             time.sleep(0.2)
 
+    # -- flight-recorder trace collection -------------------------------------
+
+    _TRACE_REQ_PREFIX = "trace.req."
+    _TRACE_REPLY_PREFIX = "trace.put."
+    _TRACE_DOWN = "trace"
+
+    def _observe_trace_request(self, message) -> bool:
+        """Server observer (transport loop thread): TRACE_GET request
+        frames — identified by their ``wire.TRACE_GET_KEY`` metadata —
+        are consumed here (ACKed, never enter the mailbox) and served
+        off-loop from the flight-recorder ring."""
+        import json as _json
+
+        raw = (message.metadata or {}).get(wire.TRACE_GET_KEY)
+        if raw is None:
+            return False
+        if message.error is not None:
+            return True  # a poisoned request carries nothing to serve
+        try:
+            req = telemetry.check_trace_request(_json.loads(raw))
+        except Exception as exc:
+            logger.warning(
+                "[%s] malformed TRACE_GET request from %s: %r",
+                self._party, message.src_party, raw,
+            )
+            # Best-effort error reply: a silent consume would leave the
+            # collector parked for its FULL per-peer timeout (a
+            # version-skewed peer is exactly when you want the reason
+            # fast).  Only possible when the reply key survived the
+            # parse failure.
+            rk = None
+            try:
+                maybe = _json.loads(raw)
+                if isinstance(maybe, dict) and isinstance(
+                    maybe.get("rk"), str
+                ):
+                    rk = maybe["rk"]
+            except Exception:
+                pass
+            if rk is not None:
+                self._codec_pool.submit(
+                    self._serve_trace_error, message.src_party, rk,
+                    f"malformed trace request: {exc!r}",
+                )
+            return True
+        self._codec_pool.submit(self._serve_trace, message.src_party, req)
+        return True
+
+    def _serve_trace_error(
+        self, requester: str, reply_key: str, err: str,
+    ) -> None:
+        """Codec-pool thread: push an err-marked empty reply so the
+        collector fails fast instead of waiting out its timeout."""
+        rep = telemetry.make_trace_reply_meta(
+            self._party, 0, armed=telemetry.installed() is not None,
+            err=err,
+        )
+        self._push_trace_reply(
+            requester, reply_key, telemetry.encode_records([]), rep,
+        )
+
+    def _serve_trace(self, requester: str, req: Dict[str, Any]) -> None:
+        """Codec-pool thread: push this party's ring window (or an
+        empty, armed=False-marked window when the recorder is disarmed)
+        to the requester's reply key."""
+        try:
+            rec = telemetry.installed()
+            rounds = req["rnd"]
+            if rec is not None:
+                window = [
+                    r for r in rec.records(
+                        rounds=None if rounds is None else tuple(rounds)
+                    )
+                    if r.party is None or r.party == self._party
+                ]
+            else:
+                window = []
+            payload = telemetry.encode_records(window)
+            rep = telemetry.make_trace_reply_meta(
+                self._party, len(window), armed=rec is not None
+            )
+        except Exception as exc:
+            logger.exception(
+                "[%s] trace window for %s could not be built",
+                self._party, requester,
+            )
+            self._serve_trace_error(
+                requester, req["rk"], f"trace serve failed: {exc!r}"
+            )
+            return
+        self._push_trace_reply(requester, req["rk"], payload, rep)
+
+    def _push_trace_reply(
+        self, requester: str, reply_key: str, payload: bytes,
+        rep: Dict[str, Any],
+    ) -> None:
+        import json as _json
+
+        metadata = {
+            wire.TRACE_PUT_KEY: _json.dumps(
+                rep, separators=(",", ":"), sort_keys=True
+            )
+        }
+        try:
+            client = self._get_client(requester)
+            cf = asyncio.run_coroutine_threadsafe(
+                client.send_data(
+                    [payload], reply_key, self._TRACE_DOWN,
+                    metadata=metadata,
+                ),
+                self._loop,
+            )
+        except Exception:
+            logger.exception(
+                "[%s] trace serve to %s could not be dispatched",
+                self._party, requester,
+            )
+            return
+
+        def _done(f) -> None:
+            exc = (
+                f.exception() if not f.cancelled()
+                else asyncio.CancelledError("transport stopped")
+            )
+            if exc is not None:
+                # Best-effort: the collector's per-peer timeout governs.
+                logger.warning(
+                    "[%s] trace serve to %s failed: %r",
+                    self._party, requester, exc,
+                )
+
+        cf.add_done_callback(_done)
+
+    def discard_empty_park(self, upstream: Any, downstream: Any) -> None:
+        """Loop-side cleanup for a CANCELLED rendezvous park (trace
+        pulls, object-plane pulls): a cancelled ``Mailbox.get`` would
+        otherwise leave an empty entry whose ``expected_src`` keeps the
+        health monitor pinging the peer forever.  Raced-in real data
+        (message present) is left for the TTL gc.  ONE copy of the
+        entry-semantics poke — the two pull protocols must not diverge
+        on it."""
+        key = (str(upstream), str(downstream))
+
+        def _discard() -> None:
+            entry = self._mailbox._entries.get(key)
+            if entry is not None and entry.message is None:
+                self._mailbox._entries.pop(key, None)
+
+        self._loop.call_soon_threadsafe(_discard)
+
+    def collect_trace(
+        self, peer: str, rounds: Any = None,
+        timeout_s: Optional[float] = None,
+    ) -> tuple:
+        """One TRACE_GET round trip against one peer: returns
+        ``(records, clock_offset, reply_meta)``.
+
+        The reply wait parks in the mailbox WITH the peer named
+        (``src_party``), so a monitor-declared-dead peer fails the
+        collection leg immediately instead of waiting out the timeout.
+        The round trip doubles as the clock-offset sample: the request
+        stamps our wall clock at send, the reply stamps the peer's at
+        serve, and :func:`telemetry.estimate_clock_offset` bounds the
+        error at RTT/2.
+        """
+        import json as _json
+        import uuid as _uuid
+
+        timeout = (
+            float(timeout_s) if timeout_s is not None
+            else float(self._job.cross_silo_timeout_s)
+        )
+        nonce = _uuid.uuid4().hex
+        reply_up = f"{self._TRACE_REPLY_PREFIX}{self._party}.{nonce}"
+        recv_cf = asyncio.run_coroutine_threadsafe(
+            self._mailbox.get(
+                reply_up, self._TRACE_DOWN, timeout_s=timeout,
+                src_party=peer,
+            ),
+            self._loop,
+        )
+        t_send = time.time()
+        req = telemetry.make_trace_request(
+            reply_up, rounds=rounds, t_send=t_send
+        )
+        metadata = {
+            wire.TRACE_GET_KEY: _json.dumps(
+                req, separators=(",", ":"), sort_keys=True
+            )
+        }
+        try:
+            client = self._get_client(peer)
+            send_cf = asyncio.run_coroutine_threadsafe(
+                client.send_data(
+                    [], f"{self._TRACE_REQ_PREFIX}{self._party}.{nonce}",
+                    self._TRACE_DOWN, metadata=metadata,
+                ),
+                self._loop,
+            )
+            send_cf.result(timeout=timeout)
+        except Exception as exc:
+            recv_cf.cancel()
+            self.discard_empty_park(reply_up, self._TRACE_DOWN)
+            raise telemetry.TelemetryError(
+                f"trace request to {peer!r} could not be delivered: "
+                f"{exc!r}"
+            ) from exc
+        try:
+            msg = recv_cf.result(timeout=timeout + 5)
+        except Exception as exc:
+            raise telemetry.TelemetryError(
+                f"no trace reply from {peer!r} within {timeout}s: {exc!r}"
+            ) from exc
+        t_recv = time.time()
+        if msg.error is not None:
+            raise telemetry.TelemetryError(
+                f"trace collection from {peer!r} failed: "
+                f"{msg.error.get('msg', msg.error)}"
+            )
+        raw_rep = (msg.metadata or {}).get(wire.TRACE_PUT_KEY)
+        if raw_rep is None:
+            raise telemetry.TelemetryError(
+                f"trace reply from {peer!r} carries no "
+                f"{wire.TRACE_PUT_KEY!r} metadata"
+            )
+        rep = telemetry.check_trace_reply_meta(_json.loads(raw_rep))
+        if rep["err"]:
+            raise telemetry.TelemetryError(
+                f"{peer!r} could not serve its trace window: {rep['err']}"
+            )
+        records = telemetry.decode_records(msg.payload)
+        offset = telemetry.estimate_clock_offset(t_send, t_recv, rep["tw"])
+        return records, offset, rep
+
     def get_stats(self) -> Dict[str, Any]:
         stats = dict(self.stats)
         stats.update(self._server.stats)
@@ -1407,4 +1709,11 @@ class TransportManager:
         # eviction counters (the "did the handle actually save bytes"
         # diagnostic — also what the rejoin bench gates read).
         stats["object_plane"] = self.objects.stats_snapshot()
+        # Flight recorder: ring occupancy/drop counters when armed (the
+        # "is my trace window still complete" diagnostic), a loud
+        # armed=False marker otherwise.
+        rec = telemetry.installed()
+        stats["telemetry"] = (
+            rec.stats() if rec is not None else {"trace_armed": False}
+        )
         return stats

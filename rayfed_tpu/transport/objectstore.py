@@ -44,10 +44,11 @@ import collections
 import json
 import logging
 import threading
+import time
 import uuid
 from typing import Any, Dict, Optional, Sequence
 
-from rayfed_tpu import objects
+from rayfed_tpu import objects, telemetry
 from rayfed_tpu.objects import ObjectPlaneError
 from rayfed_tpu.transport import wire
 
@@ -334,6 +335,17 @@ class ObjectPlane:
         data = self.store.get(fp)
         if data is not None:
             self.stats["blob_cache_hits"] += 1
+            # Flight recorder: one record per resolve with its pull
+            # temperature (warm hit / dedup ride / cold wire pull) —
+            # the "did the handle actually save bytes" question, per
+            # pull instead of summed in stats_snapshot.  Guarded: warm
+            # hits are the hot resolve path, so disarmed cost stays
+            # one global read (no argument construction).
+            if telemetry.active() is not None:
+                telemetry.event(
+                    "blob.fetch", party=self.party, nbytes=len(data),
+                    outcome="warm", detail={"fp": fp},
+                )
             return self._decode(data) if decode else data
         self.stats["blob_cache_misses"] += 1
         import concurrent.futures as _futures
@@ -356,8 +368,7 @@ class ObjectPlane:
             # plane's own loud error type, never a bare futures
             # TimeoutError.
             self.stats["blob_dedup_waits"] += 1
-            import concurrent.futures as _futures
-
+            t0_wall, t0 = time.time(), time.perf_counter()
             try:
                 data = fut.result(
                     timeout=backstop * max(1, len(handle["holders"])) + 5
@@ -368,12 +379,32 @@ class ObjectPlane:
                     f"riding did not finish within the holder-failover "
                     f"window"
                 ) from None
+            telemetry.emit(
+                "blob.fetch", party=self.party, nbytes=len(data),
+                t_start=t0_wall, dur_s=time.perf_counter() - t0,
+                outcome="dedup", detail={"fp": fp},
+            )
             return self._decode(data) if decode else data
+        t0_wall, t0 = time.time(), time.perf_counter()
         try:
             data = self.store.get(fp)  # raced-in between miss and lock
             if data is None:
                 data = self._pull(handle, backstop)
                 self.store.put(fp, data)
+                telemetry.emit(
+                    "blob.fetch", party=self.party, nbytes=len(data),
+                    t_start=t0_wall, dur_s=time.perf_counter() - t0,
+                    outcome="cold", detail={"fp": fp},
+                )
+            else:
+                # A concurrent owner completed between the miss and the
+                # inflight lock: a resolve is a resolve — every path
+                # leaves a blob.fetch record or temperature counts stop
+                # reconciling with stats_snapshot under concurrency.
+                telemetry.event(
+                    "blob.fetch", party=self.party, nbytes=len(data),
+                    outcome="warm", detail={"fp": fp, "raced": True},
+                )
             fut.set_result(data)
         except BaseException as exc:
             fut.set_exception(exc)
@@ -410,6 +441,10 @@ class ObjectPlane:
                 data = self._pull_once(fp, holder, timeout_s)
             except _HolderFailure as exc:
                 outcomes.append(f"{holder}: {exc.kind} ({exc})")
+                telemetry.event(
+                    "blob.failover", party=self.party, peer=holder,
+                    outcome=exc.kind, detail={"fp": fp},
+                )
                 if exc.kind == "corrupt":
                     self.stats["blob_corrupt_refetches"] += 1
                     logger.warning(
@@ -476,17 +511,7 @@ class ObjectPlane:
             send_cf.result(timeout=timeout_s)
         except Exception as exc:
             recv_cf.cancel()
-
-            def _discard_parked(key=(reply_up, _BLOB_DOWN)) -> None:
-                # The cancelled park would otherwise leave an empty
-                # mailbox entry whose expected_src keeps the health
-                # monitor pinging the holder forever; raced-in real
-                # data (message present) is left for the TTL gc.
-                entry = mgr._mailbox._entries.get(key)
-                if entry is not None and entry.message is None:
-                    mgr._mailbox._entries.pop(key, None)
-
-            mgr._loop.call_soon_threadsafe(_discard_parked)
+            mgr.discard_empty_park(reply_up, _BLOB_DOWN)
             raise _HolderFailure(
                 "send", f"BLOB_GET request could not be delivered: {exc!r}"
             ) from exc

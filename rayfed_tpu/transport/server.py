@@ -30,6 +30,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from rayfed_tpu import chaos
+from rayfed_tpu import telemetry
 from rayfed_tpu.transport import wire
 from rayfed_tpu.transport.rendezvous import Mailbox, Message
 
@@ -849,6 +850,25 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         )
         server.stats["receive_op_count"] += 1
         server.stats["receive_bytes"] += len(payload)
+        _tr = telemetry.active()
+        if _tr is not None:
+            # Server-side delivery record: a verified payload is about
+            # to reach its consumer (observer, chunk sink, or mailbox).
+            # This is a sync event-loop callback — the emit is a ring
+            # append, never a sleep (the fire_nonblocking discipline).
+            meta = header.get("meta") or {}
+            rnd = meta.get(wire.ROUND_TAG_KEY)
+            _tr.emit(
+                "wire.deliver", party=server._party,
+                peer=message.src_party,
+                stream=message.upstream_seq_id,
+                nbytes=len(payload),
+                t_start=time.time() - float(read_seconds or 0.0),
+                dur_s=float(read_seconds or 0.0),
+                round=int(rnd) if rnd is not None else None,
+                epoch=int(ep) if ep is not None else None,
+                outcome="error" if message.error is not None else "ok",
+            )
         key = (message.upstream_seq_id, message.downstream_seq_id)
         for obs in list(server._observers):
             try:
@@ -1176,6 +1196,21 @@ def _apply_stripe_frame(
         server.stats["receive_delta_bytes_saved"] = (
             server.stats.get("receive_delta_bytes_saved", 0)
             + total - asm.shipped
+        )
+    _tr = telemetry.active()
+    if _tr is not None:
+        # Multi-rail reassembly completed: one record per striped
+        # payload with how many cross-rail frames built it and how many
+        # bytes actually crossed the wire (delta stripes overlay a
+        # cached base).  Ring append only — may run on the loop.
+        _tr.emit(
+            "wire.reassemble", party=server._party, peer=src,
+            stream=stm, nbytes=total,
+            t_start=time.time() - asm.read_s, dur_s=asm.read_s,
+            detail={
+                "frames": asm.frames, "shipped_bytes": asm.shipped,
+                "delta": bool(asm.is_delta),
+            },
         )
     return asm.buf, asm.read_s
 

@@ -177,6 +177,28 @@ BLOB_PUT_KEY = "bput"
 # without decoding.
 BLOB_HANDLE_KEY = "bhd"
 
+# Federated flight recorder (rayfed_tpu/telemetry.py): cross-party
+# trace collection rides the SAME request/reply shape as the object
+# plane's BLOB_GET — a tiny payload-less request frame consumed by a
+# server observer, answered by an ordinary DATA push onto a per-pull
+# nonce reply key the requester is already parked on.  Two
+# frame-metadata keys on the ordinary per-send "meta" dict — NO
+# frame-layout change, but the key names AND the JSON value schemas
+# (single producers ``telemetry.make_trace_request`` /
+# ``make_trace_reply_meta``) are cross-party contracts, fingerprinted
+# by tool/check_wire_format.py together with TELEMETRY_VERSION.
+#
+# TRACE_GET_KEY — the collection REQUEST: asks a peer for its flight-
+# recorder ring window (optionally round-bounded), naming the reply
+# rendezvous key and carrying the requester's wall-clock send stamp
+# (one half of the NTP-style clock-offset estimate).
+TRACE_GET_KEY = "tget"
+# TRACE_PUT_KEY — the collection REPLY metadata: the serving party, its
+# record count, its wall clock at serve time (the offset estimate's
+# peer sample) and whether its recorder was armed.  The payload is the
+# JSON-encoded record window (``telemetry.encode_records``).
+TRACE_PUT_KEY = "tput"
+
 
 def blob_fingerprint(data) -> str:
     """Content fingerprint of a serialized payload — THE single

@@ -115,6 +115,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # max-ingress-at-any-node ratio between N=64 and N=4 stays ~flat (no
 # O(N) hub at ANY level; the flat hub's coordinator ingress scales
 # ~N/2x over the same range).
+# TELEMETRY gates (flight recorder, rayfed_tpu/telemetry.py):
+# trace_overhead_frac <= 0.03 — paired armed-vs-disarmed
+# streaming-aggregation round deltas (order-balanced pairs; drift
+# cancels in-pair), gated on the MIN over three block medians (a real
+# hot-path sleep/IO shifts every block; scheduler noise must strike
+# all three) staying within 3% (an emission is a bounded ring append,
+# never blocking I/O);
+# trace_critical_path_agrees — the cross-manager merged trace
+# (TRACE_GET/TRACE_PUT collection + clock-offset alignment) yields
+# tool/trace_report per-round critical-path walls that reconcile with
+# the driver's own measured walls within 25%, exports non-empty
+# Perfetto trace_event JSON, and carries spans from all 4 parties.
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

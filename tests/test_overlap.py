@@ -97,12 +97,16 @@ def _run_overlap_two_party(party, cluster):
     expected = C.decompress(agg)
     np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(expected["x"]))
 
-    # Per-round timing breakdown: one complete record per round, and
-    # with sub-ms comms under multi-ms compute SOME round must have hidden
-    # comms (the whole point of the overlap).
+    # Per-round timing breakdown: one complete record per round,
+    # stamped with the flight-recorder correlation keys (round/epoch/
+    # coordinator — the same tags the transport rides on frames), and
+    # with sub-ms comms under multi-ms compute SOME round must have
+    # hidden comms (the whole point of the overlap).
     assert len(timings) == rounds
-    for rec in timings:
-        assert set(rec) == {"local_s", "push_s", "agg_s", "hidden_s"}
+    for r, rec in enumerate(timings):
+        assert {"local_s", "push_s", "agg_s", "hidden_s",
+                "round", "epoch", "coordinator"} <= set(rec)
+        assert rec["round"] == r
         assert rec["agg_s"] >= 0.0 and rec["hidden_s"] >= 0.0
 
     # overlap=False (streaming) stays byte-identical to the synchronous

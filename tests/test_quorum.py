@@ -489,12 +489,20 @@ FAILOVER_ROUNDS = 5
 
 
 def _run_coord_crash(party, cluster, outdir):
+    import time
+
     import jax.numpy as jnp
 
     import rayfed_tpu as fed
     from rayfed_tpu import chaos
     from rayfed_tpu.fl import run_fedavg_rounds
     from rayfed_tpu.fl.quorum import QUORUM_STATS
+
+    # Flight recorder (satellite of the telemetry work): armed via env
+    # exactly like RAYFED_CHAOS — fed.init adopts the party — so THIS
+    # existing chaos e2e doubles as the cross-party collection test
+    # with zero new party subprocesses (the tier-1 budget note).
+    os.environ["RAYFED_TRACE"] = "1"
 
     chaos.install({
         "seed": 5,
@@ -506,6 +514,12 @@ def _run_coord_crash(party, cluster, outdir):
             # round from re-pushed contributions.
             {"hook": "announce", "party": "alice", "match": {"round": 1},
              "op": "crash_party"},
+            # A harmless injected straggle on a SURVIVOR (well under the
+            # deadline): the merged trace must show an injected chaos
+            # event from a ring that outlives the injection — the
+            # coordinator's own crash event dies with its ring.
+            {"hook": "round", "party": "carol", "match": {"round": 3},
+             "op": "delay_ms", "value": 200},
         ],
     })
     params = {"w": jnp.zeros((DIM,), jnp.float32)}
@@ -544,6 +558,24 @@ def _run_coord_crash(party, cluster, outdir):
             "round_log": log,
             "failovers": QUORUM_STATS["coordinator_failovers"],
         }, f)
+    # Cross-party trace collection over the surviving cluster: bob (the
+    # post-failover coordinator) pulls every peer's ring window — the
+    # dead coordinator must land in ``missing``, not hang the pull.
+    # The other survivors park on a marker so their transports stay up
+    # to serve their TRACE_GET requests.
+    traced_marker = os.path.join(outdir, "traced.marker")
+    if party == "bob":
+        trace = fed.trace_collect(timeout=30)
+        with open(os.path.join(outdir, "trace.json"), "w") as f:
+            json.dump(trace, f)
+        with open(traced_marker, "w") as f:
+            f.write("done")
+    else:
+        deadline = time.monotonic() + 90
+        while not os.path.exists(traced_marker):
+            if time.monotonic() > deadline:
+                raise AssertionError("collector never wrote the trace")
+            time.sleep(0.1)
     fed.shutdown()
 
 
@@ -604,6 +636,63 @@ def test_quorum_coordinator_crash_failover(tmp_path_factory):
         np.asarray(reports["bob"]["final"], dtype=np.float32),
         np.asarray(C.decompress(expect)["w"], dtype=np.float32),
     )
+
+    # Flight recorder (rayfed_tpu/telemetry.py): the merged cross-party
+    # timeline bob collected over the surviving cluster.
+    from rayfed_tpu import telemetry
+    from tool.trace_report import round_report
+
+    with open(os.path.join(outdir, "trace.json")) as f:
+        trace = json.load(f)
+    records = trace["records"]
+    assert trace["collector"] == "bob"
+    # The dead coordinator cannot serve its window — it lands in
+    # ``missing``; every survivor's ring contributes spans.
+    assert "alice" in trace["missing"], trace["missing"]
+    spans_from = {r["party"] for r in records}
+    assert {"bob", "carol", "dave"} <= spans_from, sorted(spans_from)
+    phases = {r["phase"] for r in records}
+    # Driver + transport + aggregation views joined on one timeline...
+    assert "driver.round" in phases and "wire.send" in phases, phases
+    assert any(p.startswith("agg.") for p in phases), phases
+    # ...with the coordinator-kill failover event and the injected
+    # chaos fault on the SAME timeline (every survivor recorded the
+    # failover; carol recorded her injected round-3 straggle).
+    failovers = [r for r in records if r["phase"] == "quorum.failover"]
+    assert {r["party"] for r in failovers} >= {"bob", "carol", "dave"}
+    assert all(r["detail"]["to"] == "bob" for r in failovers), failovers
+    chaos_evs = [r for r in records if r["phase"].startswith("chaos.")]
+    assert any(
+        r["party"] == "carol" and r["outcome"] == "injected"
+        for r in chaos_evs
+    ), chaos_evs
+    # Round/epoch tags stay consistent across parties: every tagged
+    # round is one the member log knows.
+    tagged = {r["round"] for r in records if r["round"] is not None}
+    assert tagged and tagged <= set(by_round), (sorted(tagged), log)
+    # The merged timeline exports as valid Perfetto trace_event JSON
+    # (one process per party, spans as "X", instants as "i").
+    perfetto = telemetry.to_trace_events(records, trace["clock_offsets"])
+    events = perfetto["traceEvents"]
+    assert events and json.loads(json.dumps(perfetto))
+    assert {e["ph"] for e in events} >= {"M", "X"}
+    proc_names = {
+        e["args"]["name"] for e in events if e["name"] == "process_name"
+    }
+    assert {"bob", "carol", "dave"} <= proc_names, proc_names
+    # Critical-path report: on the clean (post-failover-recovery)
+    # rounds the report's wall reconciles with the driver's own
+    # measured wall; the failover round is bounded by health-monitor
+    # waits the ring records too, so it must at least be present.
+    report = round_report(records, tolerance=0.5)
+    assert set(report) == tagged
+    clean = [r for r in sorted(tagged) if r >= 2]
+    assert clean and all(report[r]["wall_agrees"] for r in clean), {
+        r: (report[r]["wall_s"], report[r]["driver_wall_s"])
+        for r in sorted(report)
+    }
+    for r in clean:
+        assert report[r]["bounded_by"] is not None
 
 
 def _run_ckpt_roundtrip(party, cluster, outdir):

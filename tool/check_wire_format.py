@@ -224,6 +224,22 @@ def compute_fingerprint() -> str:
         "b1.00000000.10.aa", miss=True
     )
 
+    # Federated flight recorder (rayfed_tpu/telemetry.py): the trace-
+    # collection request/reply metadata schemas, the span-record field
+    # order (records travel as field LISTS in SPAN_FIELDS order), and
+    # the protocol semantics version — cross-party contracts riding
+    # ordinary frame metadata / payloads (the BLOB_GET request/reply
+    # shape), so their drift re-pins this lock WITHOUT a wire bump.
+    # TRACE_GET_KEY / TRACE_PUT_KEY also land in frame_metadata_keys
+    # below via the FED006 machinery.
+    from rayfed_tpu import telemetry
+
+    trace_request = telemetry.make_trace_request(
+        "trace.put.alice.nonce", rounds=(0, 3), t_send=1.0
+    )
+    trace_reply = telemetry.make_trace_reply_meta("alice", 2, t_wall=2.0)
+    trace_payload = json.loads(telemetry.encode_records([]))
+
     material = json.dumps(
         {
             "manifest_schema": _schema(manifest),
@@ -289,6 +305,17 @@ def compute_fingerprint() -> str:
             "blob_reply_schema": _schema(blob_reply),
             "blob_reply_miss_schema": _schema(blob_reply_miss),
             "object_plane_version": rf_objects.OBJECT_PLANE_VERSION,
+            # Flight recorder trace collection: the request/reply
+            # metadata keys + schemas, the span-record field order (the
+            # wire interchange form), and the telemetry protocol
+            # version — see rayfed_tpu/telemetry.py.
+            "trace_get_key": wire.TRACE_GET_KEY,
+            "trace_put_key": wire.TRACE_PUT_KEY,
+            "trace_request_schema": _schema(trace_request),
+            "trace_reply_schema": _schema(trace_reply),
+            "trace_payload_schema": _schema(trace_payload),
+            "trace_record_fields": list(telemetry.SPAN_FIELDS),
+            "telemetry_version": telemetry.TELEMETRY_VERSION,
             # Frame-metadata key constants declared in wire.py (*_KEY),
             # extracted by fedlint's FED006 machinery — the same pass
             # that forbids string-literal metadata keys in transport/
