@@ -291,10 +291,16 @@ def set_max_message_length(max_bytes: int) -> None:
     change must reject cleanly rather than torn-apply to a payload
     already on the wire (drain with ``fed.get`` on the pending sends,
     or retry after the round completes).  Each party controls its own
-    caps; lower both sides when actually shrinking a limit.  Not
-    supported for multi-host parties (``NotImplementedError``): the
-    mutation cannot reach the sibling processes' bridge servers — set
-    ``cross_silo_messages_max_size`` at :func:`init` instead.
+    caps; lower both sides when actually shrinking a limit.
+
+    On a multi-host party this is a **collective**: every process of
+    the party must call it at the same program point (like any SPMD
+    collective).  The processes rendezvous on a coordination-service
+    barrier, the leader applies the cap to the cross-party wire and its
+    bridge republish clients and publishes an ok/err verdict, and the
+    siblings apply it to their bridge servers only on ok — a rejected
+    mutation (e.g. in-flight sends) raises the same ``RuntimeError`` on
+    every process and leaves the whole party on the old cap.
     """
     runtime = get_runtime()
     transport = getattr(runtime, "transport", None)

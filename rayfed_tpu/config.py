@@ -144,6 +144,14 @@ class JobConfig:
     # (no assembly copy).  Opt-in: consumers that mutate received host
     # arrays in place need the default writable copies.
     zero_copy_host_arrays: bool = False
+    # Per-link transport backend (transport/local.py): "auto" upgrades
+    # a link to the peer's AF_UNIX listener (same host, proven via the
+    # HELLO colocation advertisement) or the in-process shared-memory
+    # handoff (same interpreter); "uds"/"shm" force one backend (loud
+    # TCP fallback when it can't hold); "off" pins TCP.  Default off:
+    # existing topologies keep their exact wire behavior unless opted
+    # in here or per-party via transport_options={"local_link": ...}.
+    local_link: str = "off"
     # Backstop deadline for a parked recv and TTL for unclaimed pushes.
     # Deliberately generous (peer *compute* time between rounds is
     # unbounded by the per-RPC timeout above); bounds leaked state from

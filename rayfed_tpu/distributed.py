@@ -28,6 +28,7 @@ resolve recvs in lockstep program order).
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import socket as _socket
 import threading
@@ -122,6 +123,16 @@ class PartyProcessGroup:
             f"{_BRIDGE_PREFIX}_addr/{pid}", int(timeout_s * 1000)
         )
 
+    def key_value_set(self, key: str, value: str) -> None:
+        """Generic control-metadata publish (leader verdicts etc.)."""
+        self._client.key_value_set(key, value)
+
+    def blocking_key_value_get(self, key: str, timeout_s: float) -> str:
+        """Generic control-metadata fetch with a deadline."""
+        return self._client.blocking_key_value_get(
+            key, int(timeout_s * 1000)
+        )
+
     def barrier(self, name: str, timeout_s: float = 120.0) -> None:
         """Party-wide barrier with a DEADLINE and a named failure: the
         raw KV barrier error is a bare status string — wrap it so the
@@ -211,6 +222,11 @@ class MultiHostTransport:
         # cleanup watchdog sees a fatal republish (exit-on-failure
         # semantics apply to the intra-party bridge too).
         self.failure_handler = None
+        # Collective-call sequence for runtime cap mutation: every
+        # process of the SPMD program calls set_max_message_size the
+        # same number of times in the same order, so a local counter
+        # names matching barrier/verdict keys on all of them.
+        self._msgcap_seq = itertools.count()
 
         if group.num_processes <= 1:
             self._bridge_ready.set()
@@ -690,20 +706,95 @@ class MultiHostTransport:
         )
 
     def set_max_message_size(self, max_bytes: int) -> None:
-        """Runtime message-size cap mutation — NOT supported for
-        multi-host parties: the mutation only reaches this process's
-        objects, while the sibling processes' bridge servers keep the
-        init-time cap — a leader that accepted a newly-allowed large
-        payload would then have its bridge republish fatally rejected
-        by a non-leader, silently desyncing the SPMD program.  Set
-        ``cross_silo_messages_max_size`` at ``fed.init`` instead."""
-        raise NotImplementedError(
-            "set_max_message_length is not supported for a multi-host "
-            "party: the cap change cannot reach the sibling processes' "
-            "bridge servers (they would fatally reject the leader's "
-            "republish of a newly-allowed large payload).  Set "
-            "cross_silo_messages_max_size at fed.init instead."
-        )
+        """Runtime message-size cap mutation, party-wide and atomic.
+
+        A multi-host party must move the cap on EVERY process at once:
+        the leader's wire server/clients AND each sibling's bridge
+        server — a leader that accepted a newly-allowed large payload
+        while one bridge server kept the init-time cap would have its
+        republish fatally rejected there, silently desyncing the SPMD
+        program.  This is therefore a **collective**: every process of
+        the party calls ``fed.set_max_message_length`` at the same
+        program point (like any other SPMD collective).
+
+        Protocol: enter-barrier (no process still has a pre-call send
+        in flight once all have arrived) → the leader applies to its
+        real manager (which itself rejects on in-flight cross-party
+        sends) and its bridge republish clients, then publishes an
+        ``ok``/``err:...`` verdict on the coordination KV → non-leaders
+        fetch the verdict and apply to their bridge manager only on
+        ``ok`` → exit-barrier.  On an ``err`` verdict every process
+        raises the same ``RuntimeError``, so a rejected mutation leaves
+        the whole party on the old cap — never torn across processes.
+        """
+        max_bytes = int(max_bytes)
+        if max_bytes <= 0:
+            raise ValueError(
+                f"max message length must be positive, got {max_bytes}"
+            )
+        if self._group.num_processes <= 1:
+            if self._inner is not None:
+                self._inner.set_max_message_size(max_bytes)
+            return
+
+        seq = next(self._msgcap_seq)
+        verdict_key = f"{_BRIDGE_PREFIX}_msgcap/{seq}"
+        self._group.barrier(f"rfw_msgcap_enter_{seq}")
+        if self._group.is_leader:
+            verdict = "ok"
+            try:
+                self._leader_apply_cap(max_bytes)
+            except Exception as e:
+                verdict = f"err:{e}"
+            self._group.key_value_set(verdict_key, verdict)
+        else:
+            verdict = self._group.blocking_key_value_get(verdict_key, 120.0)
+            if verdict == "ok" and self._bridge_mgr is not None:
+                # Bridge managers never originate sends, so the inner
+                # inflight guard is vacuous here — this is a plain
+                # server/job-config cap update on the bridge listener.
+                self._bridge_mgr.set_max_message_size(max_bytes)
+        self._group.barrier(f"rfw_msgcap_exit_{seq}")
+        if verdict != "ok":
+            raise RuntimeError(
+                f"set_max_message_length rejected for multi-host party "
+                f"(no process applied it): {verdict[4:]}"
+            )
+
+    def _leader_apply_cap(self, max_bytes: int) -> None:
+        """Leader side of the cap collective: real manager + bridge
+        republish clients.  The bridge inflight check runs FIRST so a
+        busy bridge rejects before the inner manager mutates — inside
+        the enter-barrier no process is issuing new sends, so the
+        check-then-apply window cannot readmit traffic."""
+
+        async def _check_bridge():
+            busy = sorted(
+                pid
+                for pid, c in self._bridge_clients.items()
+                if c.has_inflight_sends()
+            )
+            if busy:
+                raise RuntimeError(
+                    f"cannot change max message length while bridge "
+                    f"republishes are in flight to party processes "
+                    f"{busy}; retry after the round completes"
+                )
+
+        async def _apply_bridge():
+            for c in self._bridge_clients.values():
+                c._max_message_size = max_bytes
+
+        loop = self._inner._loop
+        if self._bridge_clients:
+            asyncio.run_coroutine_threadsafe(
+                _check_bridge(), loop
+            ).result(timeout=30)
+        self._inner.set_max_message_size(max_bytes)
+        if self._bridge_clients:
+            asyncio.run_coroutine_threadsafe(
+                _apply_bridge(), loop
+            ).result(timeout=30)
 
     def effective_transport_options(self, dest_party: str) -> Dict[str, Any]:
         if self._inner is not None:

@@ -334,6 +334,9 @@ class StreamingAggregator:
         self._needs_reset = False
         self._cond = threading.Condition()
         self._acc = None
+        # True when the integer fold runs as plain numpy slice-adds
+        # instead of per-block jit calls (decided in _init_acc).
+        self._np_fold = False
         self._total_elems = -1
         self._nblocks = -1
         self._wire_dtype: Optional[np.dtype] = None
@@ -605,12 +608,17 @@ class StreamingAggregator:
         quorum rollback).  The retained payloads and local arrays are
         the refold sources — pure local compute, no re-wire."""
         if self._acc is not None:
-            import jax.numpy as jnp
+            if self._np_fold:
+                self._acc = np.zeros(
+                    self._nblocks * self._chunk_elems, np.int32
+                )
+            else:
+                import jax.numpy as jnp
 
-            self._acc = jnp.zeros(
-                self._nblocks * self._chunk_elems,
-                jnp.int32 if self._quant is not None else jnp.float32,
-            )
+                self._acc = jnp.zeros(
+                    self._nblocks * self._chunk_elems,
+                    jnp.int32 if self._quant is not None else jnp.float32,
+                )
         for s in self._streams:
             s.applied_blocks = 0
 
@@ -869,10 +877,33 @@ class StreamingAggregator:
         self._nblocks = packed_block_grid(
             self._total_elems, self._chunk_elems
         )
-        self._acc = jnp.zeros(
-            self._nblocks * self._chunk_elems,
-            jnp.int32 if self._quant is not None else jnp.float32,
+        # CPU integer folds skip jit: a per-block jit dispatch costs
+        # ~100µs on the CPU backend — with N virtual parties each
+        # folding a region's stripes (the hierarchy bench) that
+        # dispatch tax alone dominated the round wall.  i32 adds are
+        # exact and order-independent, so numpy slice-adds produce the
+        # identical accumulator bit for bit (the keystone byte-identity
+        # invariant holds by arithmetic, not by sharing the kernel).
+        # The float path stays on jit unconditionally — XLA may fuse
+        # multiply-add with different rounding than numpy's two-step —
+        # and masked rounds keep the device accumulator their mod-2³²
+        # correction kernel consumes.
+        import jax
+
+        self._np_fold = (
+            self._quant is not None
+            and not self._masked
+            and jax.default_backend() == "cpu"
         )
+        if self._np_fold:
+            self._acc = np.zeros(
+                self._nblocks * self._chunk_elems, np.int32
+            )
+        else:
+            self._acc = jnp.zeros(
+                self._nblocks * self._chunk_elems,
+                jnp.int32 if self._quant is not None else jnp.float32,
+            )
 
     def _avail_blocks(self, s: _Stream) -> int:
         if s.complete:
@@ -1022,7 +1053,7 @@ class StreamingAggregator:
                         self._streams[i].t_complete for i in order
                     )
             # Apply outside the lock (sinks keep landing bytes meanwhile).
-            if kernel is None:
+            if kernel is None and not self._np_fold:
                 if self._quant is not None:
                     # The integer-accumulate path: widening i32
                     # multiply-add of the codes (fl.fedavg, beside the
@@ -1053,13 +1084,22 @@ class StreamingAggregator:
                 else:
                     w = np.float32(self._weights[i])
                 t0 = time.perf_counter()
-                for b in range(lo, hi):
-                    self._acc = kernel(
-                        self._acc,
-                        self._chunk_np(src, b),
-                        np.int32(b * self._chunk_elems),
-                        w,
-                    )
+                if self._np_fold:
+                    ce = self._chunk_elems
+                    wi = np.int32(w)
+                    for b in range(lo, hi):
+                        off = b * ce
+                        self._acc[off:off + ce] += (
+                            wi * self._chunk_np(src, b).astype(np.int32)
+                        )
+                else:
+                    for b in range(lo, hi):
+                        self._acc = kernel(
+                            self._acc,
+                            self._chunk_np(src, b),
+                            np.int32(b * self._chunk_elems),
+                            w,
+                        )
                 self._busy_s += time.perf_counter() - t0
                 with self._cond:
                     s.applied_blocks = hi

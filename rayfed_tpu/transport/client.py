@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 from rayfed_tpu import chaos
 from rayfed_tpu import telemetry
 from rayfed_tpu.config import RetryPolicy
+from rayfed_tpu.transport import local
 from rayfed_tpu.transport import wire
 
 logger = logging.getLogger(__name__)
@@ -54,6 +55,13 @@ _MAX_DELTA_STREAMS = 32
 # interleaved flows (past ~4 rails a single sender saturates either the
 # NIC or the CRC/copy stage anyway).
 MAX_STRIPE_RAILS = 4
+
+# Shared-memory sends at/under this size materialize INLINE on the
+# event loop: the copy is a few µs, while an executor round trip costs
+# two thread wakeups + GIL handoffs — pure overhead at stripe scale.
+# Above it, the gather (and any device→host produce) moves off-loop so
+# a large handoff can't stall unrelated traffic sharing the loop.
+_INLINE_MATERIALIZE_BYTES = 256 * 1024
 
 
 def _default_stripe_rails() -> int:
@@ -236,6 +244,8 @@ class TransportClient:
         stripe_rails: Optional[int] = None,
         dead_check: Optional[Any] = None,
         secagg: Optional[Any] = None,
+        local_link: str = "off",
+        checksum_pinned: bool = False,
     ) -> None:
         if checksum is None:
             # Match the manager's policy: checksum only when the fast C++
@@ -246,6 +256,37 @@ class TransportClient:
 
             checksum = native.is_available()
         self._checksum = checksum
+        # Local-link fast path (transport/local.py).  The backend is a
+        # PER-LINK decision made once, on the first contact with the
+        # destination: same process → shared-memory handoff; same host
+        # (HELLO colocation proof) → the peer's AF_UNIX twin listener;
+        # otherwise (or on any local-path failure) TCP, loudly.  CRC is
+        # elided on adopted local links — the bytes never cross a wire —
+        # unless the operator pinned `checksum` explicitly.  A TLS link
+        # never upgrades: the operator asked for encryption, and an
+        # AF_UNIX socket silently dropping it is not a fast path.
+        local_link = str(local_link or "off").lower()
+        if local_link not in local.LINK_MODES:
+            logger.warning(
+                "[%s] unknown local_link mode %r for %s; using 'off'",
+                src_party, local_link, dest_party,
+            )
+            local_link = "off"
+        if local_link != "off" and ssl_context is not None:
+            logger.warning(
+                "[%s] local_link=%r to %s disabled: the link is TLS and "
+                "must not downgrade to a plaintext local socket",
+                src_party, local_link, dest_party,
+            )
+            local_link = "off"
+        self._local_mode = local_link
+        self._local_decided = local_link == "off"
+        self._link_backend = "tcp"  # tcp | uds | shm (live backend)
+        self._local_endpoint: Optional[local.LocalEndpoint] = None
+        self._uds_path: Optional[str] = None
+        self._local_fallback: Optional[str] = None  # decision/fallback reason
+        self._checksum_cfg = checksum  # restore on TCP fallback
+        self._checksum_pinned = bool(checksum_pinned)
         self._src_party = src_party
         self._dest_party = dest_party
         host, _, port = address.rpartition(":")
@@ -344,6 +385,158 @@ class TransportClient:
             "send_striped_payloads": 0,
             "send_stripe_frames": 0,
         }
+        # Per-backend split of the stage breakdown (tcp/uds/shm): the
+        # suffixed counters sum to the unsuffixed ones above, so a
+        # local-link regression is attributable from metrics alone.
+        # For shm, "socket" is the handoff→ACK wait (there is no
+        # socket; the receiver's dispatch latency plays its role).
+        for _b in ("tcp", "uds", "shm"):
+            for _k in ("d2h", "copy", "crc", "loop_wait", "socket"):
+                self.stats[f"send_{_k}_s_{_b}"] = 0.0
+
+    def _bill_backend(
+        self, backend: Optional[str] = None, d2h: float = 0.0,
+        copy: float = 0.0, crc: float = 0.0, loop_wait: float = 0.0,
+        socket: float = 0.0,
+    ) -> None:
+        """Accumulate stage seconds under the live backend's counters
+        (the unsuffixed totals are billed by the callers as before)."""
+        b = backend or self._link_backend
+        st = self.stats
+        if d2h:
+            st[f"send_d2h_s_{b}"] += d2h
+        if copy:
+            st[f"send_copy_s_{b}"] += copy
+        if crc:
+            st[f"send_crc_s_{b}"] += crc
+        if loop_wait:
+            st[f"send_loop_wait_s_{b}"] += loop_wait
+        if socket:
+            st[f"send_socket_s_{b}"] += socket
+
+    def local_link_info(self) -> Dict[str, Any]:
+        """The link's backend decision, for effective_transport_options:
+        configured mode, the live backend, whether the decision is made
+        (first contact decides), and the fallback/decision reason."""
+        return {
+            "mode": self._local_mode,
+            "backend": self._link_backend,
+            "decided": self._local_decided,
+            "fallback": self._local_fallback,
+        }
+
+    # -- local-link backend decision ------------------------------------------
+
+    def _adopt_local(self, backend: str) -> None:
+        self._local_decided = True
+        self._link_backend = backend
+        if not self._checksum_pinned:
+            # CRC elision on trusted local links: the bytes never leave
+            # the machine, so the whole-payload CRC32C guards nothing a
+            # kernel memcpy doesn't already.  (Per-chunk stream CRCs
+            # survive on uds — they double as the delta cache's base
+            # fingerprints; shm bypasses the delta machinery entirely.)
+            self._checksum = False
+        logger.debug(
+            "[%s] link to %s upgraded to %s",
+            self._src_party, self._dest_party, backend,
+        )
+
+    def _adopt_shm(self, endpoint: local.LocalEndpoint) -> None:
+        self._local_endpoint = endpoint
+        self._adopt_local("shm")
+
+    def _pin_tcp(self, reason: str, loud: bool = False) -> None:
+        """Decide (or fall back to) TCP for this link.  ``loud`` marks a
+        degradation the operator asked not to have (forced uds/shm that
+        can't hold, a mid-session AF_UNIX failure) vs auto-detection
+        correctly concluding the peer is remote."""
+        self._local_decided = True
+        self._link_backend = "tcp"
+        self._local_endpoint = None
+        self._uds_path = None
+        self._local_fallback = reason
+        self._checksum = self._checksum_cfg
+        (logger.warning if loud else logger.debug)(
+            "[%s] local link to %s: using TCP — %s",
+            self._src_party, self._dest_party, reason,
+        )
+
+    def _consider_upgrade(self, reply: Dict[str, Any]) -> Optional[str]:
+        """Decide the link backend from a HELLO reply's advertisement.
+
+        Returns "uds" when the caller must redial over the advertised
+        AF_UNIX path; "shm"/None mean the connection at hand stays
+        usable (shm routes DATA through the in-process handoff but keeps
+        the TCP connection as a valid control path)."""
+        self._local_decided = True
+        mode = self._local_mode
+        if mode in ("auto", "shm"):
+            ep = local.lookup_token(reply.get(wire.LOCAL_TOKEN_KEY))
+            if ep is not None:
+                self._adopt_shm(ep)
+                return "shm"
+            if mode == "shm":
+                self._pin_tcp(
+                    "local_link=shm but the destination server does not "
+                    "live in this process", loud=True,
+                )
+                return None
+        host_id = reply.get(wire.LOCAL_HOST_KEY)
+        uds_path = reply.get(wire.LOCAL_UDS_KEY)
+        colocated = (
+            host_id is not None and host_id == local.host_identity()
+        )
+        if mode == "uds" or (mode == "auto" and colocated):
+            if uds_path:
+                if not colocated:
+                    # Forced uds without the boot-scoped host proof:
+                    # honor the operator (containers can hide
+                    # machine-id while sharing a mount), but say so.
+                    logger.warning(
+                        "[%s] local_link=uds to %s: no colocation proof "
+                        "(host identity mismatch); trusting the "
+                        "advertised path %s",
+                        self._src_party, self._dest_party, uds_path,
+                    )
+                self._uds_path = uds_path
+                self._adopt_local("uds")
+                return "uds"
+            self._pin_tcp(
+                "peer advertises no AF_UNIX listener",
+                loud=(mode == "uds"),
+            )
+            return None
+        self._pin_tcp(
+            "peer is not colocated" if not colocated
+            else f"local_link={mode!r} declines this backend",
+        )
+        return None
+
+    async def _ensure_local_backend(self) -> None:
+        """Make the link's backend decision before the first operation.
+
+        Same-process destinations are found in the local registry with
+        NO socket at all (at N=64 virtual parties, probe connections
+        alone were a ~2k-socket storm per round); otherwise one pooled
+        TCP connection's HELLO reply carries the advertisement and
+        :meth:`_open_conn` applies the upgrade."""
+        if self._local_decided:
+            return
+        if self._local_mode in ("auto", "shm"):
+            ep = local.lookup_addr(self._host, self._port)
+            if ep is not None:
+                self._adopt_shm(ep)
+                return
+        try:
+            await self._acquire_conn()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # The probe failed before any HELLO decided anything: leave
+            # the decision open — the operation's own connect path
+            # surfaces (and retries) the real error.
+            pass
 
     # -- connection management ------------------------------------------------
 
@@ -352,13 +545,31 @@ class TransportClient:
             await chaos.fire_async(
                 "connect", party=self._src_party, dest=self._dest_party
             )
-        reader, writer = await asyncio.open_connection(
-            self._host,
-            self._port,
-            ssl=self._ssl_context,
-            server_hostname=self._server_hostname if self._ssl_context else None,
-            limit=2**20,
-        )
+        use_uds = self._link_backend == "uds" and self._uds_path is not None
+        if use_uds:
+            try:
+                reader, writer = await asyncio.open_unix_connection(
+                    self._uds_path, limit=2**20
+                )
+            except (OSError, NotImplementedError) as e:
+                # Loud mid-session fallback: the peer restarted (socket
+                # unlinked) or the path went away.  TCP (and the
+                # configured checksum policy) is restored for good.
+                self._pin_tcp(
+                    f"AF_UNIX connect to {self._uds_path} failed: {e}",
+                    loud=True,
+                )
+                use_uds = False
+        if not use_uds:
+            reader, writer = await asyncio.open_connection(
+                self._host,
+                self._port,
+                ssl=self._ssl_context,
+                server_hostname=(
+                    self._server_hostname if self._ssl_context else None
+                ),
+                limit=2**20,
+            )
         fd: Optional[int] = None
         if self._ssl_context is None:
             from rayfed_tpu import native
@@ -394,6 +605,19 @@ class TransportClient:
                 conn.reader_task = None
             self._teardown(conn, SendError("handshake failed"))
             raise
+        if not self._local_decided:
+            # First contact decides the link backend from the HELLO
+            # advertisement (transport/local.py).  A uds verdict retires
+            # this TCP probe and redials over the advertised path —
+            # depth-1 recursion, the decision is made now.
+            if self._consider_upgrade(reply) == "uds":
+                if conn.reader_task is not None:
+                    conn.reader_task.cancel()
+                    conn.reader_task = None
+                self._teardown(
+                    conn, SendError("link upgraded to AF_UNIX")
+                )
+                return await self._open_conn()
         return conn
 
     async def _acquire_rails(self, k: int) -> List[_Conn]:
@@ -442,13 +666,19 @@ class TransportClient:
             return self._ctl_conn
 
     async def _read_responses(self, conn: _Conn) -> None:
+        # Local snapshot: _teardown/_really_close null conn.reader, and
+        # a cancel() issued between this task's awaits is only DELIVERED
+        # at the next await — the attribute read before it must not race
+        # the close into an AttributeError (the stream object itself
+        # just raises IncompleteReadError once its transport closed).
+        reader = conn.reader
         try:
             while True:
-                prefix = await conn.reader.readexactly(wire.HEADER_SIZE)
+                prefix = await reader.readexactly(wire.HEADER_SIZE)
                 msg_type, _flags, hlen, plen = wire.unpack_frame_prefix(prefix)
-                header = json.loads(await conn.reader.readexactly(hlen)) if hlen else {}
+                header = json.loads(await reader.readexactly(hlen)) if hlen else {}
                 if plen:
-                    await conn.reader.readexactly(plen)
+                    await reader.readexactly(plen)
                 rid = header.get("rid")
                 fut = conn.pending.pop(rid, None)
                 if fut is None or fut.done():
@@ -740,6 +970,7 @@ class TransportClient:
         self.stats["send_d2h_s"] += d2h_s
         self.stats["send_crc_s"] += crc_s
         self.stats["send_socket_s"] += write_s
+        self._bill_backend(d2h=d2h_s, crc=crc_s, socket=write_s)
         frame_wall = time.perf_counter() - t_frame0
         self.stats["send_frame_wall_s"] += frame_wall
         _tr = telemetry.active()
@@ -946,7 +1177,11 @@ class TransportClient:
             st["send_copy_s"] += copy_s
             st["send_crc_s"] += crc_s
             st["send_prepare_s"] += d2h_s + copy_s + crc_s
-            st["send_loop_wait_s"] += max(0.0, time.perf_counter() - t_ready)
+            loop_wait_s = max(0.0, time.perf_counter() - t_ready)
+            st["send_loop_wait_s"] += loop_wait_s
+            self._bill_backend(
+                d2h=d2h_s, copy=copy_s, crc=crc_s, loop_wait=loop_wait_s
+            )
             hdr = dict(base_header)
             hdr["ccrc"] = [crc]
             hdr["dlt"] = wire.make_delta_manifest(
@@ -1092,6 +1327,14 @@ class TransportClient:
             )
         self._inflight_sends += 1
         try:
+            if not self._local_decided:
+                await self._ensure_local_backend()
+            if self._link_backend == "shm" and self._local_endpoint is not None:
+                return await self._send_shm(
+                    payload_bufs, upstream_seq_id, downstream_seq_id,
+                    metadata=metadata, crc=crc, error=error,
+                    stream_snapshot=stream_snapshot,
+                )
             return await self._send_data_impl(
                 payload_bufs, upstream_seq_id, downstream_seq_id,
                 metadata=metadata, crc=crc, error=error, stream=stream,
@@ -1099,6 +1342,156 @@ class TransportClient:
             )
         finally:
             self._inflight_sends -= 1
+
+    async def _shm_roundtrip(
+        self, msg_type: int, header: Dict[str, Any], payload,
+        timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """One in-process frame handoff, with the socket path's chaos
+        semantics: the "wire" hook fires on every frame, "frame" on DATA
+        (its mutable header is how corrupt_crc plants a wrong declared
+        checksum the receiver's mismatch path then catches)."""
+        if chaos.installed() is not None:
+            await chaos.fire_async(
+                "wire", party=self._src_party, dest=self._dest_party,
+                type=msg_type,
+            )
+        header = dict(header, rid=next(self._rid))
+        if msg_type == wire.MSG_DATA and chaos.installed() is not None:
+            await chaos.fire_async(
+                "frame", party=self._src_party, dest=self._dest_party,
+                header=header,
+            )
+        return await local.deliver(
+            self._local_endpoint, msg_type, header, payload,
+            self._timeout_s if timeout_s is None else timeout_s,
+        )
+
+    async def _send_shm(
+        self,
+        payload_bufs: List,
+        upstream_seq_id: str,
+        downstream_seq_id: str,
+        metadata: Optional[Dict[str, str]] = None,
+        crc: Optional[int] = None,
+        error: Optional[Dict[str, str]] = None,
+        stream_snapshot: Optional[tuple] = None,
+    ) -> str:
+        """Same-process delivery: one gather copy, zero socket writes.
+
+        The payload is materialized into ONE freshly-allocated buffer
+        (or a fan-out's shared snapshot is passed as-is — also fresh
+        per send) and handed to the destination server BY REFERENCE;
+        per-chunk CRC and the delta cache are bypassed — diff passes
+        and checksums that save wire bytes are pure loss when there is
+        no wire, so stream sends ship full payloads here and the
+        ``delta_*`` counters intentionally stay still.  Delivery
+        semantics match the socket path: retry ladder, ACK deadline
+        (non-retried), epoch rejects, chunk sinks, telemetry.
+        """
+        total = wire.payload_nbytes(payload_bufs)
+        if total > self._max_message_size:
+            raise SendError(
+                f"message of {total} bytes exceeds configured max "
+                f"{self._max_message_size}"
+            )
+        merged_meta = dict(self._metadata)
+        if metadata:
+            merged_meta.update(metadata)
+        base_header: Dict[str, Any] = {
+            "src": self._src_party,
+            "up": str(upstream_seq_id),
+            "down": str(downstream_seq_id),
+            "meta": merged_meta,
+        }
+        if error is not None:
+            base_header["err"] = error
+        if crc is not None and self._checksum:
+            # Pinned-checksum links keep the precomputed digest (the
+            # receiver verifies it); elided links drop it.
+            base_header["crc"] = crc
+        loop = asyncio.get_running_loop()
+        t_frame0 = time.perf_counter()
+        if stream_snapshot is not None:
+            payload: Any = stream_snapshot[0]
+            d2h_s = copy_s = 0.0  # billed to the fan-out's codec pass
+        elif 0 < total <= _INLINE_MATERIALIZE_BYTES:
+            # Small payload: the executor round trip (two thread hops +
+            # a GIL handoff each) costs more than the copy itself — at
+            # N=64 virtual parties the hierarchy round hands off ~2k
+            # stripe-sized frames, all under this bound.
+            payload, d2h_s, copy_s = local.materialize(payload_bufs)
+        elif total:
+            payload, d2h_s, copy_s = await loop.run_in_executor(
+                None, local.materialize, payload_bufs
+            )
+        else:
+            payload, d2h_s, copy_s = bytearray(0), 0.0, 0.0
+        policy = self._retry_policy
+        backoff: Optional[float] = None
+        last_exc: Optional[Exception] = None
+        for attempt in range(max(1, policy.max_attempts)):
+            if attempt:
+                if self._dest_known_dead():
+                    self._dead_fast_fail(last_exc)
+                backoff = policy.next_backoff(backoff)
+                logger.debug(
+                    "[%s] retrying shm send to %s in %.2fs (attempt %d/%d)",
+                    self._src_party, self._dest_party, backoff,
+                    attempt + 1, policy.max_attempts,
+                )
+                await asyncio.sleep(backoff)
+            t_hand = time.perf_counter()
+            try:
+                ack = await self._shm_roundtrip(
+                    wire.MSG_DATA, base_header, payload
+                )
+            except FatalSendError:
+                raise
+            except asyncio.TimeoutError as e:
+                raise SendError(
+                    f"send to {self._dest_party} timed out after "
+                    f"{self._timeout_s}s"
+                ) from e
+            except (SendError, OSError, ConnectionError) as e:
+                last_exc = e
+                logger.debug(
+                    "[%s] shm send to %s attempt %d/%d failed: %s",
+                    self._src_party, self._dest_party, attempt + 1,
+                    policy.max_attempts, e,
+                )
+                continue
+            handoff_s = time.perf_counter() - t_hand
+            st = self.stats
+            st["send_frames"] += 1
+            st["send_payload_bytes"] += total
+            st["send_prepare_s"] += d2h_s + copy_s
+            st["send_d2h_s"] += d2h_s
+            st["send_copy_s"] += copy_s
+            st["send_socket_s"] += handoff_s
+            self._bill_backend(
+                backend="shm", d2h=d2h_s, copy=copy_s, socket=handoff_s
+            )
+            frame_wall = time.perf_counter() - t_frame0
+            st["send_frame_wall_s"] += frame_wall
+            _tr = telemetry.active()
+            if _tr is not None:
+                _tr.emit(
+                    "wire.frame", party=self._src_party,
+                    peer=self._dest_party, nbytes=total,
+                    t_start=time.time() - frame_wall, dur_s=frame_wall,
+                    detail={
+                        "backend": "shm",
+                        "d2h_ms": round(d2h_s * 1e3, 3),
+                        "crc_ms": 0.0,
+                        "socket_ms": round(handoff_s * 1e3, 3),
+                    },
+                )
+            return ack.get("result", "OK")
+        raise SendError(
+            f"send to {self._dest_party} failed after "
+            f"{policy.max_attempts} attempts: {last_exc}"
+        )
 
     async def _send_data_impl(
         self,
@@ -1424,6 +1817,9 @@ class TransportClient:
                 st["send_copy_s"] += totals[1]
                 st["send_crc_s"] += totals[2]
                 st["send_prepare_s"] += sum(totals)
+                self._bill_backend(
+                    d2h=totals[0], copy=totals[1], crc=totals[2]
+                )
             else:
                 # Fresh stripe-sized payload: production is pipelined
                 # with the stripe frames inside the attempt loop.
@@ -1604,6 +2000,25 @@ class TransportClient:
         and leaving no extra long-lived socket behind when no monitor
         runs."""
         try:
+            if not self._local_decided:
+                await self._ensure_local_backend()
+            if self._link_backend == "shm" and self._local_endpoint is not None:
+                if chaos.installed() is None:
+                    # In-process peer: liveness is a registry verdict,
+                    # not a roundtrip — N virtual parties' health
+                    # monitors each ping every monitored peer per tick,
+                    # an O(N²) control storm that was ~a third of the
+                    # N=64 hierarchy round wall; and a ping DEADLINE
+                    # under GIL starvation reads busy as dead exactly
+                    # when the process is loaded.
+                    return local.endpoint_alive(self._local_endpoint)
+                # Chaos armed: ride the handoff so an injected
+                # partition starves the PONG exactly like on a wire.
+                await self._shm_roundtrip(
+                    wire.MSG_PING, {"src": self._src_party}, b"",
+                    timeout_s=timeout_s,
+                )
+                return True
             conn = await self._acquire_ctl_conn() if ctl else None
             await self._roundtrip(
                 wire.MSG_PING, {"src": self._src_party}, [],

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from rayfed_tpu import telemetry
 from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
 from rayfed_tpu.executor import LocalRef
+from rayfed_tpu.transport import local
 from rayfed_tpu.transport import secagg as secagg_keys
 from rayfed_tpu.transport import tls as tls_utils
 from rayfed_tpu.transport import wire
@@ -41,7 +42,8 @@ logger = logging.getLogger(__name__)
 # operator typo like "tiemout_s" then just... did nothing).
 _KNOWN_TRANSPORT_OPTIONS = frozenset(
     {"timeout_s", "max_message_size", "checksum", "connections_per_peer",
-     "stripe_rails", "heartbeat_interval_s", "death_deadline_s"}
+     "stripe_rails", "heartbeat_interval_s", "death_deadline_s",
+     "local_link"}
 )
 # Reference-style gRPC channel-arg keys accepted for drop-in compat.
 _COMPAT_TRANSPORT_OPTIONS = {
@@ -634,6 +636,13 @@ class TransportManager:
                 self._job.peer_health_interval_s
                 * max(1, int(self._job.peer_death_pings))
             ),
+            # Per-link transport backend (transport/local.py): "auto"
+            # upgrades a link to the peer's AF_UNIX listener (same
+            # host) or the in-process shared-memory handoff (same
+            # process); "off" (the default) pins TCP — existing
+            # topologies keep their exact wire behavior unless opted
+            # in per-job or per-party.
+            "local_link": getattr(self._job, "local_link", "off"),
         }
         party_opts = dict(self._cluster.party_config(dest_party).transport_options)
         # Accept reference-style gRPC channel-arg keys for drop-in compat.
@@ -708,17 +717,24 @@ class TransportManager:
         opts = self._merged_options(dest_party)
         with self._clients_lock:
             client = self._clients.get(dest_party)
+        link_info = None
         if client is not None:
             opts["timeout_s"] = client._timeout_s
             opts["max_message_size"] = client._max_message_size
             opts["checksum"] = client.checksum_enabled
             opts["connections_per_peer"] = client._pool_size
             opts["stripe_rails"] = client._stripe_rails()
+            opts["local_link"] = client._local_mode
+            # The LIVE backend decision too (mode is the ask, backend
+            # the outcome): {mode, backend, decided, fallback} — the
+            # "did my link actually upgrade, and if not why" accessor.
+            link_info = client.local_link_info()
         return {
             "party": dest_party,
             "options": opts,
             "ignored_keys": list(self._ignored_options.get(dest_party, [])),
             "metadata": self.merged_metadata(dest_party),
+            "local_link": link_info,
         }
 
     def set_max_message_size(self, max_bytes: int) -> None:
@@ -799,6 +815,15 @@ class TransportManager:
                         p in self._mailbox.dead_parties_snapshot()
                     ),
                     secagg=self.secagg_keys,
+                    local_link=str(opts.get("local_link", "off")),
+                    # An explicit per-party/job checksum survives local-
+                    # link CRC elision: the operator pinned it.
+                    checksum_pinned=(
+                        "checksum"
+                        in self._cluster.party_config(
+                            dest_party
+                        ).transport_options
+                    ),
                 )
                 self._clients[dest_party] = client
             return client
@@ -1039,13 +1064,15 @@ class TransportManager:
                 t0 = time.perf_counter()
                 try:
                     client = self._get_client(p)
-                    cf = asyncio.run_coroutine_threadsafe(
+                    # Coalesced wake: an N-way fan-out arms the loop
+                    # once, not once per destination (local.py batcher).
+                    cf = local.post_coroutine(
+                        self._loop,
                         client.send_data(bufs, str(upstream_seq_id),
                                          str(downstream_seq_id), crc=crc,
                                          metadata=final_meta,
                                          stream=stream,
                                          stream_snapshot=snapshot),
-                        self._loop,
                     )
                 except Exception as e:  # pragma: no cover - construction
                     logger.warning(
@@ -1138,7 +1165,10 @@ class TransportManager:
         device_put = self._job.device_put_received
 
         t_req = time.time()
-        cf = asyncio.run_coroutine_threadsafe(
+        # post_coroutine, not run_coroutine_threadsafe: a round's worth
+        # of parked recvs (N-1 in a hierarchy region) arms the loop once.
+        cf = local.post_coroutine(
+            self._loop,
             self._mailbox.get(
                 str(upstream_seq_id),
                 str(downstream_seq_id),
@@ -1149,7 +1179,6 @@ class TransportManager:
                 # src_party dies (peer-death fail-fast).
                 src_party=src_party,
             ),
-            self._loop,
         )
         # Delivery timestamp for the mailbox.wait span: _decode runs on
         # the codec pool AFTER a queue hop, so stamping inside it would
@@ -1677,6 +1706,36 @@ class TransportManager:
             "crc_ms": round(stats["send_crc_s"] * 1e3, 2),
             "loop_wait_ms": round(stats["send_loop_wait_s"] * 1e3, 2),
             "socket_ms": round(stats["send_socket_s"] * 1e3, 2),
+        }
+        # Same stages split per transport backend (local-link fast
+        # path): the tcp/uds/shm rows sum to the totals above minus the
+        # codec-pool encode (which runs before the backend is chosen),
+        # so a local-link regression is attributable from metrics
+        # alone.  For shm, socket_ms is the handoff→ACK wait.
+        stats["send_path_breakdown_by_backend_ms"] = {
+            b: {
+                "encode_ms": round(
+                    sum(c.stats[f"send_copy_s_{b}"] for c in clients) * 1e3,
+                    2,
+                ),
+                "d2h_ms": round(
+                    sum(c.stats[f"send_d2h_s_{b}"] for c in clients) * 1e3, 2
+                ),
+                "crc_ms": round(
+                    sum(c.stats[f"send_crc_s_{b}"] for c in clients) * 1e3, 2
+                ),
+                "loop_wait_ms": round(
+                    sum(c.stats[f"send_loop_wait_s_{b}"] for c in clients)
+                    * 1e3,
+                    2,
+                ),
+                "socket_ms": round(
+                    sum(c.stats[f"send_socket_s_{b}"] for c in clients)
+                    * 1e3,
+                    2,
+                ),
+            }
+            for b in ("tcp", "uds", "shm")
         }
         # Fraction of stream-send logical bytes the delta cache kept off
         # the wire (0.0 when no stream sends happened).

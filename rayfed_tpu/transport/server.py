@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from rayfed_tpu import chaos
 from rayfed_tpu import telemetry
+from rayfed_tpu.transport import local
 from rayfed_tpu.transport import wire
 from rayfed_tpu.transport.rendezvous import Mailbox, Message
 
@@ -493,6 +494,19 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 if peer_adv and src:
                     sa.record_peer(str(src), peer_adv)
                 reply[wire.SECAGG_PUB_KEY] = sa.hello_value()
+            # Local-link colocation advertisement (transport/local.py):
+            # always volunteered — three small strings per handshake.
+            # The CLIENT decides whether to upgrade; a TLS listener
+            # stays out of it (a link the operator encrypts must not
+            # silently downgrade to an unencrypted AF_UNIX socket).
+            if server._ssl_context is None:
+                reply[wire.LOCAL_HOST_KEY] = local.host_identity()
+                if server._local_sid is not None:
+                    reply[wire.LOCAL_TOKEN_KEY] = local.endpoint_token(
+                        server._local_sid
+                    )
+                if server._uds_path is not None:
+                    reply[wire.LOCAL_UDS_KEY] = server._uds_path
             self._reply(wire.MSG_HELLO, reply)
             return
         if msg_type == wire.MSG_PING:
@@ -1287,6 +1301,13 @@ class TransportServer:
         # Live connections (loop thread only): stop() aborts them so
         # peers see EOF promptly instead of half-open sockets.
         self._protocols: set = set()
+        # Local-link fast path (transport/local.py): the AF_UNIX twin
+        # listener (same frames, same dispatch — just not the loopback
+        # TCP stack) and this server's in-process registry id, both
+        # advertised in HELLO replies so colocated clients can upgrade.
+        self._uds_path: Optional[str] = None
+        self._uds_server: Optional[asyncio.AbstractServer] = None
+        self._local_sid: Optional[str] = None
 
     def _note_stripe_evicted(self, key, sid: int) -> None:
         """Record an evicted in-progress stripe group (caller holds
@@ -1357,6 +1378,28 @@ class TransportServer:
         )
         if self._port == 0:  # OS-assigned (bridge listeners)
             self._port = self._server.sockets[0].getsockname()[1]
+        if self._ssl_context is None:
+            # AF_UNIX twin listener (local-link fast path): same
+            # protocol, advertised in HELLO replies.  Best-effort — a
+            # host without a writable tmpdir just never advertises one,
+            # and clients keep TCP.  TLS listeners opt out entirely (an
+            # encrypted link must not downgrade to a plain socket).
+            path = local.make_uds_path()
+            try:
+                self._uds_server = await loop.create_unix_server(
+                    lambda: _FrameProtocol(self), path
+                )
+                self._uds_path = path
+            except (OSError, NotImplementedError) as e:
+                logger.debug(
+                    "[%s] no AF_UNIX twin listener: %s", self._party, e
+                )
+            # In-process registry: colocated clients in THIS interpreter
+            # discover the server object itself (shared-memory handoff)
+            # without a probe connection.
+            self._local_sid = local.register_server(
+                self, loop, self._host, self._port
+            )
         logger.debug("[%s] transport server listening on %s:%s",
                      self._party, self._host, self._port)
 
@@ -1365,6 +1408,20 @@ class TransportServer:
         return self._port
 
     async def stop(self) -> None:
+        local.unregister_server(self._local_sid)
+        self._local_sid = None
+        if self._uds_server is not None:
+            self._uds_server.close()
+            await self._uds_server.wait_closed()
+            self._uds_server = None
+        if self._uds_path is not None:
+            try:
+                import os
+
+                os.unlink(self._uds_path)
+            except OSError:
+                pass
+            self._uds_path = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

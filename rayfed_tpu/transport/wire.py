@@ -242,6 +242,31 @@ def blob_fingerprint(data) -> str:
 # fingerprinted by tool/check_wire_format.py.
 SECAGG_PUB_KEY = "sapk"
 
+# Local-link colocation advertisement (transport/local.py) — three HELLO
+# header keys the server volunteers on every handshake so a client can
+# prove colocation and upgrade the link off TCP.  No frame-layout
+# change: like SECAGG_PUB_KEY these ride the existing HELLO header, but
+# the key names (and the identity semantics behind them) are
+# cross-party contracts fingerprinted by tool/check_wire_format.py.
+#
+# LOCAL_HOST_KEY — the server host's boot-scoped identity fingerprint
+# (``local.host_identity``: machine-id + boot-id hash).  A client whose
+# own fingerprint matches has PROVED both ends share a kernel, which is
+# what makes the advertised AF_UNIX path dialable and the CRC elision
+# trustworthy (the bytes never leave the machine).
+LOCAL_HOST_KEY = "lh"
+# LOCAL_UDS_KEY — filesystem path of the server's AF_UNIX twin listener
+# (same frame parser, same wire lock; absent when the listener could
+# not be created).  Only meaningful when LOCAL_HOST_KEY matched: a path
+# from a different host (or an unshared mount namespace) simply fails
+# to connect, which the client treats as a loud fall-back to TCP.
+LOCAL_UDS_KEY = "lu"
+# LOCAL_TOKEN_KEY — the server PROCESS's random boot token
+# (``local.process_token``): equality with the client's own token
+# proves same-process (in-process virtual parties), unlocking the
+# shared-memory handoff that skips sockets entirely.
+LOCAL_TOKEN_KEY = "lt"
+
 
 def pack_frame(
     msg_type: int,

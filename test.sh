@@ -114,7 +114,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # one broadcast in), and (3) hier_ingress_flatness <= 1.6 — the
 # max-ingress-at-any-node ratio between N=64 and N=4 stays ~flat (no
 # O(N) hub at ANY level; the flat hub's coordinator ingress scales
-# ~N/2x over the same range).
+# ~N/2x over the same range), and (4) hier_round_ratio_64_over_16 <= 8
+# — the N=64 round wall within 8x of N=16 although the message count
+# grows ~14x (the local-link fast path's per-message-cost gate; ~23x
+# before it), with flight-recorder trace_phases attribution landing in
+# the report alongside the number.
+# LOCAL-LINK gates (transport/local.py, per-link backend upgrade):
+# local_link_vs_wire >= 2.0 — a colocated pair (shm handoff via
+# local_link="auto") must move the send-path payload shape at >= 2x
+# the loopback-TCP FedAvg-path wire rate — and the auto probe must
+# actually have picked the shm backend for a same-interpreter pair
+# (local_link_backend == "shm"; uds is reported alongside as
+# local_link_uds_GBps).
 # TELEMETRY gates (flight recorder, rayfed_tpu/telemetry.py):
 # trace_overhead_frac <= 0.03 — paired armed-vs-disarmed
 # streaming-aggregation round deltas (order-balanced pairs; drift

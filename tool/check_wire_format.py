@@ -113,8 +113,16 @@ def compute_fingerprint() -> str:
     # connection; both sides parse these header keys, and the version
     # value is what a ProtocolMismatchError names.  The secagg key
     # advertisement (wire.SECAGG_PUB_KEY) rides the same header —
-    # optional on the wire, but its key name is contract.
-    hello_header_keys = ["ver", "src", wire.SECAGG_PUB_KEY]
+    # optional on the wire, but its key name is contract.  So are the
+    # local-link colocation advertisements (transport/local.py): host
+    # identity, AF_UNIX twin-listener path, in-process server token —
+    # all optional on the wire (an old peer ignores them and stays on
+    # TCP), but their NAMES are contract, and their drift re-pins this
+    # lock WITHOUT a wire bump (no frame-layout change).
+    hello_header_keys = [
+        "ver", "src", wire.SECAGG_PUB_KEY,
+        wire.LOCAL_HOST_KEY, wire.LOCAL_UDS_KEY, wire.LOCAL_TOKEN_KEY,
+    ]
 
     # Secure aggregation (fl.secagg / transport.secagg): the HELLO
     # advertisement format + seed-derivation semantics version, and the
