@@ -1424,18 +1424,21 @@ def _fill_objectplane_extra(extra: dict, s: dict) -> None:
 
 def _run_hierarchy_bench(_party: str, result_q) -> None:
     """Hierarchical aggregation traffic-vs-N: region rings + quantized
-    cross-region partial-sum streaming at N ∈ {4, 16, 64}
+    cross-region partial-sum streaming at N ∈ {4, 16, 64, 256}
     (fl.hierarchy), with N in-process VIRTUAL parties — one
     TransportManager per party, real loopback sockets, party threads
     driving the same ``HierarchyRound`` the fed driver ships (the
-    multi-manager shape of the secagg bench, NOT 64 subprocesses — the
+    multi-manager shape of the secagg bench, NOT 256 subprocesses — the
     tier-1 budget is binding).
 
-    Fixed region COUNT (2) with growing region size, so both levels'
-    fan-in stays bounded as N grows: the region ring spreads the code
-    ingress across members, the root sees (regions−1) partial-sum
-    buffers, and the broadcast fans down the tree.  Per round and per
-    N the parent gates (test.sh):
+    N ≤ 64 keeps the fixed region COUNT (2) with growing region size
+    (the historical 2-level gates); N=256 is the MULTI-LEVEL leg — 16
+    regions of 16 folding through branch=4 interior nodes (16 → 4 →
+    1), quorum-hub leaves, region-ring downlink, an FD-ceiling check
+    before the 256 managers are built, and a seeded straggling-region
+    chaos round that the per-region quorum cutoff must absorb with
+    zero flatten-fallbacks.  Per round and per N the parent gates
+    (test.sh):
 
     - ``hier_bitexact`` — the hierarchical aggregate is BYTE-identical
       (on every one of the N parties) to the one-shot
@@ -1456,9 +1459,14 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
       8× of N=16 (raw message count grows ~14×; the local-link fast
       path's per-message cost is what keeps the wall from tracking it).
       The flight recorder runs over the measured rounds at N ∈ {16,
-      64} and the per-phase wall attribution lands in the report
+      64, 256} and the per-phase wall attribution lands in the report
       (``trace_phases``), so a regression arrives with its own
       diagnosis attached.
+    - ``hier_round_ratio_256_over_64`` ≤ 4 — the thousand-silo scaling
+      gate; ``hier_root_egress_frac_256`` ≤ 8 — root bytes out stay
+      ~O(branch·|model|), flat in N (the region-ring downlink's whole
+      point); ``hier_chaos_fallbacks`` = 0 with ≥ 1 region cutoff —
+      the straggling region is absorbed, not flattened.
 
     Colocated parties upgrade to the shm local link (``local_link:
     "auto"``) — this bench IS the colocated topology the fast path
@@ -1470,6 +1478,7 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
     real deployment runs one party per process.
     """
     import gc
+    import resource
     import socket
     import threading
     from collections import defaultdict
@@ -1482,6 +1491,7 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
     from rayfed_tpu.fl import compression as fl_comp
     from rayfed_tpu.fl import fedavg as fl_fedavg
     from rayfed_tpu.fl import quantize as qz
+    from rayfed_tpu.fl import hierarchy as fl_hier
     from rayfed_tpu.fl.hierarchy import HierarchyRound
     from rayfed_tpu.transport.manager import TransportManager
 
@@ -1513,9 +1523,49 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
         )
 
     report = {"model_bytes": model_bytes}
-    for n_parties in (4, 16, 64):
-        parties = [f"h{i:02d}" for i in range(n_parties)]
-        region_size = n_parties // 2  # 2 regions at every N
+    # N=256 packs ~256 listening sockets + local-link endpoints + the
+    # lazy per-peer connections of a constant-degree tree into ONE
+    # process: raise the FD soft ceiling toward the hard one up front
+    # and check the headroom BEFORE building 256 managers.
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < 16_384:
+        try:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(16_384, hard), hard)
+            )
+        except (ValueError, OSError):
+            pass
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    report["fd_soft_limit"] = int(soft)
+
+    # (N, region_size, branch, hub leaves): the first three legs keep
+    # the fixed-2-region shape (the historical PR 12/16 gates); N=256
+    # is the multi-level leg — 16 regions of 16 fold through branch=4
+    # interior nodes (16 -> 4 -> 1), the deadline-capable quorum hub
+    # replaces the stripe ring at the leaves, and the region-ring
+    # downlink carries the broadcast (root egress ~O(branch·|model|),
+    # flat in N).
+    sweep = [
+        (4, 2, None, False),
+        (16, 8, None, False),
+        (64, 32, None, False),
+        (256, 16, 4, True),
+    ]
+    for n_parties, region_size, branch, hub in sweep:
+        if n_parties >= 256 and soft < 4_096:
+            report["n256_skipped"] = (
+                f"fd soft ceiling {soft} < 4096 (hard {hard})"
+            )
+            break
+        parties = [f"h{i:03d}" for i in range(n_parties)]
+        lay = fl_hier.region_layout(parties, region_size, branch=branch)
+        hier_kw = {}
+        if branch is not None:
+            hier_kw["branch"] = branch
+        if hub:
+            # Full-region quorum for the measured rounds: the hub path
+            # is exercised, no member is cut, bitexact covers ALL N.
+            hier_kw["region_quorum"] = region_size
         ports = dict(zip(parties, free_ports(n_parties)))
 
         def mk(party):
@@ -1543,7 +1593,7 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
         for m in mgrs.values():
             m.start()
 
-        def do_round(r: int, tag: str):
+        def do_round(r: int, tag: str, delays=None, extra_kw=None):
             results, errors = {}, {}
 
             def run_party(p, i):
@@ -1555,7 +1605,10 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
                         keys=[f"{tag}{r}k{j}" for j in range(6)],
                         stream="hb", backstop=300,
                         quant_downlink=True,
+                        **{**hier_kw, **(extra_kw or {})},
                     )
+                    if delays and p in delays:
+                        time.sleep(delays[p])
                     results[p] = rnd.run(contribution(i, r))
                 except BaseException as e:  # surfaces in the parent
                     errors[p] = e
@@ -1583,9 +1636,13 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
             p: int(m.get_stats()["receive_bytes"])
             for p, m in mgrs.items()
         }
+        tx0 = {
+            p: int(m.get_stats()["send_bytes"])
+            for p, m in mgrs.items()
+        }
         # Flight recorder over the measured rounds at the two gated N:
         # per-phase wall attribution ships WITH the number it explains.
-        traced = n_parties in (16, 64)
+        traced = n_parties in (16, 64, 256)
         if traced:
             telemetry.install(f"hier_bench_n{n_parties}",
                               capacity=1 << 20)
@@ -1620,12 +1677,54 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
             p: int(mgrs[p].get_stats()["receive_bytes"]) - rx0[p]
             for p in parties
         }
+        tx = {
+            p: int(mgrs[p].get_stats()["send_bytes"]) - tx0[p]
+            for p in parties
+        }
         link_backend = (
             mgrs[parties[0]]
             .effective_transport_options(parties[1])
             .get("local_link", {})
             .get("backend")
         )
+
+        # Seeded chaos schedule (multi-level leg only): one region's
+        # members straggle past the region deadline; the per-region
+        # quorum cutoff absorbs them (the arrived subset's partial sum
+        # folds up, the root reweights) — the round COMPLETES, zero
+        # abort-and-flatten fallbacks, every party byte-agrees.
+        chaos = None
+        if hub:
+            chaos_rng = np.random.default_rng(2026)
+            cg = int(chaos_rng.integers(1, len(lay.regions)))
+            coord_cg = lay.coordinators[cg]
+            stragglers = [
+                p for p in lay.live[cg] if p != coord_cg
+            ][:5]
+            cutoffs0 = fl_hier.HIER_STATS["region_cutoffs"]
+            aborted0 = fl_hier.HIER_STATS["rounds_aborted"]
+            _, cres = do_round(
+                9, "c", delays={p: 2.0 for p in stragglers},
+                extra_kw={
+                    "region_quorum": region_size - len(stragglers),
+                    "region_deadline_s": 0.75,
+                },
+            )
+            cblobs = {
+                np.asarray(t.buf).tobytes() for t in cres.values()
+            }
+            chaos = {
+                "straggler_region": cg,
+                "stragglers": len(stragglers),
+                "completed": len(cres),
+                "cutoffs": int(
+                    fl_hier.HIER_STATS["region_cutoffs"] - cutoffs0
+                ),
+                "fallbacks": int(
+                    fl_hier.HIER_STATS["rounds_aborted"] - aborted0
+                ),
+                "agree": len(cblobs) == 1,
+            }
         for m in mgrs.values():
             m.stop()
 
@@ -1657,6 +1756,10 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
             "bitexact": bool(bitexact),
             "party_bytes": total_rx / n_parties / rounds,
             "max_ingress": max(rx.values()) / rounds,
+            # The root's per-round bytes OUT: the region-ring downlink
+            # keeps this ~O(branch·|model|), FLAT in N (coordinator
+            # fan-out would grow it O(N·|model|)).
+            "root_egress": tx[lay.root] / rounds,
             "round_s": min(walls),
             "link_backend": link_backend,
             # What the flat hub's coordinator would ingest per round
@@ -1664,6 +1767,28 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
             # the no-O(N)-hub headline.
             "hub_max_ingress": (n_parties - 1) * n_elems,
         }
+        if branch is not None:
+            # Per-level max ingress: parties grouped by the HIGHEST
+            # tree level they coordinate (0 = plain member, 1 = leaf
+            # region coordinator, 1+k = level-k interior coordinator;
+            # coordinatorship is prefix-closed so max() is the role).
+            role = {p: 0 for p in parties}
+            for g in lay.active:
+                role[lay.coordinators[g]] = 1
+            for k, level in enumerate(lay.levels, start=2):
+                for nd in level.values():
+                    role[nd.coordinator] = max(role[nd.coordinator], k)
+            by_role = defaultdict(list)
+            for p in parties:
+                by_role[role[p]].append(rx[p])
+            report[f"n{n_parties}"]["per_level_ingress_frac"] = {
+                f"l{k}": round(
+                    max(v) / rounds / (2.0 * model_bytes), 3
+                )
+                for k, v in sorted(by_role.items())
+            }
+        if chaos is not None:
+            report[f"n{n_parties}"]["chaos"] = chaos
         if trace_phases is not None:
             report[f"n{n_parties}"]["trace_phases"] = trace_phases
     result_q.put(("hierarchy", report))
@@ -1672,14 +1797,19 @@ def _run_hierarchy_bench(_party: str, result_q) -> None:
 def _fill_hierarchy_extra(extra: dict, s: dict) -> None:
     model2 = 2.0 * s["model_bytes"]  # the 2·|model| flat-traffic budget
     bitexact = True
-    for n in (4, 16, 64):
-        sec = s[f"n{n}"]
+    for n in (4, 16, 64, 256):
+        sec = s.get(f"n{n}")
+        if sec is None:  # N=256 skipped below the FD ceiling
+            continue
         bitexact = bitexact and sec["bitexact"]
         extra[f"hier_party_bytes_frac_{n}"] = round(
             sec["party_bytes"] / model2, 3
         )
         extra[f"hier_max_ingress_frac_{n}"] = round(
             sec["max_ingress"] / model2, 3
+        )
+        extra[f"hier_root_egress_frac_{n}"] = round(
+            sec["root_egress"] / model2, 3
         )
         extra[f"hier_round_ms_{n}"] = round(sec["round_s"] * 1e3, 1)
     extra["hier_bitexact"] = bitexact
@@ -1699,6 +1829,24 @@ def _fill_hierarchy_extra(extra: dict, s: dict) -> None:
         s["n64"]["hub_max_ingress"] / max(1.0, s["n64"]["max_ingress"]),
         2,
     )
+    n256 = s.get("n256")
+    if n256 is not None:
+        # THE thousand-silo gate: the N=256 multi-level round wall
+        # within 4x of the N=64 wall (message count grows ~4x; the
+        # constant-degree tree + region-ring downlink keep per-node
+        # work flat), with the root's egress flat in N.
+        extra["hier_round_ratio_256_over_64"] = round(
+            n256["round_s"] / max(1e-9, s["n64"]["round_s"]), 2
+        )
+        chaos = n256.get("chaos") or {}
+        extra["hier_chaos_fallbacks"] = chaos.get("fallbacks")
+        extra["hier_chaos_cutoffs"] = chaos.get("cutoffs")
+        extra["hier_chaos_agree"] = chaos.get("agree")
+        extra["hier_level_ingress_256"] = n256.get(
+            "per_level_ingress_frac"
+        )
+    else:
+        extra["hier_n256_skipped"] = s.get("n256_skipped", "missing")
     _log(
         f"  hierarchy: per-party bytes "
         f"{extra['hier_party_bytes_frac_4']:.2f}x / "
@@ -1717,6 +1865,24 @@ def _fill_hierarchy_extra(extra: dict, s: dict) -> None:
         f"(64/16 ratio {extra['hier_round_ratio_64_over_16']:.1f}, "
         f"link={extra['hier_link_backend']})"
     )
+    if n256 is not None:
+        _log(
+            f"  hierarchy N=256 (multi-level, 16 regions x 16, "
+            f"branch=4): round {extra['hier_round_ms_256']:.0f} ms "
+            f"(256/64 ratio "
+            f"{extra['hier_round_ratio_256_over_64']:.1f}, gate <= 4), "
+            f"root egress {extra['hier_root_egress_frac_256']:.2f}x of "
+            f"2|model| (N=64: "
+            f"{extra['hier_root_egress_frac_64']:.2f}x), per-level "
+            f"ingress {extra['hier_level_ingress_256']}, chaos "
+            f"straggling-region: {extra['hier_chaos_cutoffs']} "
+            f"cutoff(s), {extra['hier_chaos_fallbacks']} fallback(s), "
+            f"agree={extra['hier_chaos_agree']}"
+        )
+    else:
+        _log(
+            f"  hierarchy N=256 SKIPPED: {extra['hier_n256_skipped']}"
+        )
 
 
 def _fill_compressed_extra(extra: dict, s: dict) -> None:
@@ -4661,7 +4827,7 @@ def main() -> None:
             _log("hierarchical-aggregation smoke (region rings + "
                  "quantized cross-region streaming, traffic-vs-N at "
                  "N=4/16/64 virtual parties)...")
-            hr = _one_child("_run_hierarchy_bench", ndev=1, timeout=420)
+            hr = _one_child("_run_hierarchy_bench", ndev=1, timeout=600)
             _fill_hierarchy_extra(extra, hr)
         with _section(extra, "chaos"):
             _log("chaos smoke (quorum=2 rounds under injected straggler "
@@ -4824,8 +4990,10 @@ def main() -> None:
                 "recode) on some party/N"
             )
             raise SystemExit(1)
-        for _n in (4, 16, 64):
+        for _n in (4, 16, 64, 256):
             hpf = extra.get(f"hier_party_bytes_frac_{_n}")
+            if _n == 256 and hpf is None:
+                continue  # leg skipped below the FD ceiling
             if hpf is None or hpf > 1.25:
                 _log(
                     f"hierarchy smoke gate FAILED: "
@@ -4855,6 +5023,52 @@ def main() -> None:
                 f"trace_phases in the hierarchy section)"
             )
             raise SystemExit(1)
+        # CI gates (test.sh), multi-level leg — skipped only when the
+        # FD ceiling forced the N=256 leg off: (5) the N=256 round
+        # wall within 4x of N=64 (the thousand-silo scaling gate),
+        # (6) root egress flat in N (region-ring downlink: coordinator
+        # fan-out would sit ~32x of 2|model| at N=256), (7) the seeded
+        # straggling-region chaos round completes with ZERO
+        # abort-and-flatten fallbacks (the per-region cutoff absorbs
+        # it) and full cross-party byte agreement.
+        if "hier_round_ratio_256_over_64" in extra:
+            hr256 = extra["hier_round_ratio_256_over_64"]
+            if hr256 is None or hr256 > 4.0:
+                _log(
+                    f"hierarchy smoke gate FAILED: "
+                    f"hier_round_ratio_256_over_64={hr256} (must be "
+                    f"<= 4; see the per-level trace_phases +"
+                    f" hier_level_ingress_256 for which tree level "
+                    f"regressed)"
+                )
+                raise SystemExit(1)
+            regress = extra.get("hier_root_egress_frac_256")
+            if regress is None or regress > 8.0:
+                _log(
+                    f"hierarchy smoke gate FAILED: "
+                    f"hier_root_egress_frac_256={regress} (root bytes "
+                    f"out must stay ~O(branch·|model|), <= 8x of "
+                    f"2|model| — O(N) coordinator fan-out is back)"
+                )
+                raise SystemExit(1)
+            if (
+                extra.get("hier_chaos_fallbacks") != 0
+                or extra.get("hier_chaos_agree") is not True
+                or not extra.get("hier_chaos_cutoffs")
+            ):
+                _log(
+                    f"hierarchy smoke gate FAILED: seeded "
+                    f"straggling-region chaos round — fallbacks="
+                    f"{extra.get('hier_chaos_fallbacks')} (must be 0), "
+                    f"cutoffs={extra.get('hier_chaos_cutoffs')} (must "
+                    f"be >= 1), agree={extra.get('hier_chaos_agree')}"
+                )
+                raise SystemExit(1)
+        else:
+            _log(
+                "hierarchy N=256 gates SKIPPED (FD ceiling): "
+                + str(extra.get("hier_n256_skipped"))
+            )
         # CI gate (test.sh): the ring must actually de-bottleneck the
         # coordinator — its share of cluster ingress bytes at or near
         # 1/N, never above 0.4 (the hub pins ~0.5 regardless of N).

@@ -665,6 +665,9 @@ def run_quorum_rounds(
     wire_quant: Optional[str] = None,
     secure_agg: bool = False,
     region_size: Optional[int] = None,
+    region_branch: Optional[int] = None,
+    region_quorum: Optional[int] = None,
+    region_deadline_s: Optional[float] = None,
     server_opt: Optional[Any] = None,
 ) -> Any:
     """The quorum-mode round loop behind ``run_fedavg_rounds(quorum=k)``.
@@ -674,8 +677,14 @@ def run_quorum_rounds(
     - aggregation is always the quorum-aware streaming round
       (:func:`quorum_aggregate`); ``mode="ring"`` tries the ring first
       and falls back to it when the ring aborts; ``mode="hierarchy"``
-      (requires ``wire_quant`` + ``region_size``) tries the two-level
-      region topology (:mod:`rayfed_tpu.fl.hierarchy`) first — a
+      (requires ``wire_quant`` + ``region_size``) tries the region
+      topology (:mod:`rayfed_tpu.fl.hierarchy`) first — two-level by
+      default, recursively multi-level via ``region_branch=``, with
+      per-region quorum cutoffs via ``region_quorum=`` /
+      ``region_deadline_s=`` (a straggling region's arrived subset is
+      folded at the deadline and the root reweights to the arrived
+      Σw, so the flatten fallback below is reserved for structural
+      failures) — a
       hierarchy abort (e.g. a dead region coordinator) re-aggregates
       the SAME round over the flat quorum path, where the cutoff
       excludes the corpse, the announcement drops it, and a dead
@@ -777,6 +786,25 @@ def run_quorum_rounds(
                 "exclusive — pairwise masks only cancel over the full "
                 "party set (fl.hierarchy)"
             )
+        if region_branch is not None and int(region_branch) < 2:
+            raise QuorumRoundError(
+                f"region_branch must be >= 2, got {region_branch!r}"
+            )
+        if region_quorum is not None and int(region_quorum) < 1:
+            raise QuorumRoundError(
+                f"region_quorum must be >= 1, got {region_quorum!r}"
+            )
+        if region_deadline_s is not None and region_quorum is None:
+            raise QuorumRoundError(
+                "region_deadline_s needs region_quorum= (the "
+                "per-region minimum arrived count the deadline gates)"
+            )
+    elif (region_branch is not None or region_quorum is not None
+          or region_deadline_s is not None):
+        raise QuorumRoundError(
+            "region_branch/region_quorum/region_deadline_s only apply "
+            "to mode='hierarchy'"
+        )
     sopt = None
     sopt_descr = None
     if server_opt is not None:
@@ -1063,6 +1091,9 @@ def run_quorum_rounds(
                     epoch=epoch, mode=mode,
                     ring_chunk_elems=ring_chunk_elems,
                     region_size=region_size,
+                    region_branch=region_branch,
+                    region_quorum=region_quorum,
+                    region_deadline_s=region_deadline_s,
                     announce_fn=announce_fn, backstop=backstop,
                     active=active, timings=rec,
                     quant=round_grid, quant_ref=round_ref,
@@ -1277,7 +1308,9 @@ def _aggregate_with_mode(
     runtime, updates, w_map, *, session, round_index, quorum, deadline_s,
     coordinator, stream, epoch, mode, ring_chunk_elems, announce_fn,
     backstop, active, timings, quant=None, quant_ref=None,
-    quant_scope=None, secagg=None, region_size=None, server_step=None,
+    quant_scope=None, secagg=None, region_size=None,
+    region_branch=None, region_quorum=None, region_deadline_s=None,
+    server_step=None,
 ) -> QuorumRoundOutcome:
     """Topology-first aggregation when ``mode`` is ``"ring"`` or
     ``"hierarchy"``: a straggler or dead party aborts the topology
@@ -1407,6 +1440,13 @@ def _aggregate_with_mode(
                 None if w_map is None
                 else [w_map[p] for p in sorted(updates)],
                 region_size=int(region_size),
+                region_branch=region_branch,
+                # Per-region quorum: a slow or partially-dead region
+                # contributes its deadline-gated arrived subset instead
+                # of aborting the tree — the flat-quorum fallback below
+                # becomes the exception, not the straggler path.
+                region_quorum=region_quorum,
+                region_deadline_s=region_deadline_s,
                 stream=f"{stream}/hier",
                 quant=quant, quant_ref=quant_ref,
                 quant_scope=quant_scope,

@@ -71,6 +71,9 @@ def validate_round_config(
     overlap: bool = False,
     ring_chunk_elems: Optional[int] = None,
     region_size: Optional[int] = None,
+    region_branch: Optional[int] = None,
+    region_quorum: Optional[int] = None,
+    region_deadline_s: Optional[float] = None,
     quorum: Optional[int] = None,
     round_deadline_s: Optional[float] = None,
     join_ticket: Optional[dict] = None,
@@ -276,6 +279,41 @@ def validate_round_config(
             "region_size only applies to mode='hierarchy' (it sets "
             "the deterministic region partition width)"
         )
+    if region_branch is not None:
+        if mode != "hierarchy":
+            raise ValueError(
+                "region_branch only applies to mode='hierarchy' (it "
+                "sets the interior tree degree of the derived "
+                "multi-level hierarchy)"
+            )
+        if int(region_branch) < 2:
+            raise ValueError(
+                f"region_branch must be >= 2 (a 1-ary interior level "
+                f"folds nothing), got {region_branch!r}"
+            )
+    if region_quorum is not None:
+        if mode != "hierarchy":
+            raise ValueError(
+                "region_quorum only applies to mode='hierarchy' (it "
+                "sets the per-region minimum arrived count for the "
+                "deadline-gated region cutoff)"
+            )
+        if int(region_quorum) < 1:
+            raise ValueError(
+                f"region_quorum must be >= 1 (the minimum arrived "
+                f"member count per region), got {region_quorum!r}"
+            )
+    if region_deadline_s is not None:
+        if region_quorum is None:
+            raise ValueError(
+                "region_deadline_s needs region_quorum= (the "
+                "per-region minimum arrived count the deadline gates)"
+            )
+        if float(region_deadline_s) <= 0:
+            raise ValueError(
+                f"region_deadline_s must be positive, got "
+                f"{region_deadline_s!r}"
+            )
     if mode == "ring":
         if not (compress_wire and packed_wire):
             raise ValueError(
@@ -464,6 +502,9 @@ def run_fedavg_rounds(
     timings: Optional[list] = None,
     ring_chunk_elems: Optional[int] = None,
     region_size: Optional[int] = None,
+    region_branch: Optional[int] = None,
+    region_quorum: Optional[int] = None,
+    region_deadline_s: Optional[float] = None,
     quorum: Optional[int] = None,
     round_deadline_s: Optional[float] = None,
     join_ticket: Optional[dict] = None,
@@ -618,6 +659,19 @@ def run_fedavg_rounds(
       ``mode="hierarchy"`` (regions are contiguous slices of the
       sorted roster — every controller derives the identical partition
       from the identical roster epoch, no negotiation).
+    - ``region_branch``: interior tree degree of ``mode="hierarchy"``
+      (>= 2).  When the region count exceeds the branch, the tree
+      recurses: region coordinators group ``region_branch`` at a time
+      under interior nodes, level by level, until one root remains —
+      the regrouped integer folds stay byte-identical to the flat sum
+      at any depth.  Default: one interior level (the 2-level tree).
+    - ``region_quorum`` / ``region_deadline_s``: per-region quorum
+      cutoffs for ``mode="hierarchy"``.  Once ``region_quorum``
+      members of a region have delivered and ``region_deadline_s``
+      has elapsed, the region coordinator folds the arrived subset
+      and moves on — the root reweights to the true arrived Σw, so a
+      straggling region delays only itself, not the tree, and the
+      abort-and-flatten fallback is reserved for structural failures.
     - ``coordinator``: which party anchors coordinator-mode rounds and
       ring fallbacks (default: the canonically-first — ``min`` — party).
       Exposed mainly for tests and for deployments whose first party is
@@ -712,6 +766,9 @@ def run_fedavg_rounds(
         overlap=overlap,
         ring_chunk_elems=ring_chunk_elems,
         region_size=region_size,
+        region_branch=region_branch,
+        region_quorum=region_quorum,
+        region_deadline_s=region_deadline_s,
         quorum=quorum,
         round_deadline_s=round_deadline_s,
         join_ticket=join_ticket,
@@ -833,6 +890,9 @@ def run_fedavg_rounds(
             wire_quant=_qname if wire_quant is not None else None,
             secure_agg=secure_agg,
             region_size=region_size,
+            region_branch=region_branch,
+            region_quorum=region_quorum,
+            region_deadline_s=region_deadline_s,
             server_opt=packed_opt,
         )
 
@@ -1076,6 +1136,9 @@ def run_fedavg_rounds(
                     avg = hierarchy_aggregate(
                         updates, weights,
                         region_size=int(region_size),
+                        region_branch=region_branch,
+                        region_quorum=region_quorum,
+                        region_deadline_s=region_deadline_s,
                         stream="fedavg",
                         server_step=step_fn,
                         quant=round_grid, quant_ref=round_ref,

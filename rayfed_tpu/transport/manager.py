@@ -20,7 +20,7 @@ import concurrent.futures
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from rayfed_tpu import telemetry
 from rayfed_tpu.config import ClusterConfig, JobConfig, RetryPolicy
@@ -234,6 +234,30 @@ def partition_regions(
         raise ValueError("cannot partition an empty roster")
     s = int(region_size)
     return [ps[i : i + s] for i in range(0, len(ps), s)]
+
+
+def branch_groups(
+    node_ids: Sequence[int], branch: int
+) -> List[Tuple[int, List[int]]]:
+    """Deterministic constant-degree grouping of one tree level.
+
+    Groups node ids by ``id // branch`` over the FULL id range of the
+    level — NOT by packing the surviving ids densely — so a node's
+    parent is a pure function of its own id and never moves when a
+    sibling's subtree dies.  Every controller derives the identical
+    grouping from the identical roster epoch, the same zero-negotiation
+    contract as :func:`partition_regions`; the multi-level hierarchy
+    (:mod:`rayfed_tpu.fl.hierarchy`) applies this rule recursively
+    until a single top node remains.  Returns ``(parent_id, children)``
+    pairs sorted by parent id, children in ascending id order.
+    """
+    if int(branch) < 2:
+        raise ValueError(f"branch must be >= 2, got {branch}")
+    b = int(branch)
+    grouped: Dict[int, List[int]] = {}
+    for cid in sorted(node_ids):
+        grouped.setdefault(cid // b, []).append(cid)
+    return sorted(grouped.items())
 
 
 def ring_neighbors(parties: Sequence[str], party: str) -> tuple:

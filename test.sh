@@ -118,7 +118,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # — the N=64 round wall within 8x of N=16 although the message count
 # grows ~14x (the local-link fast path's per-message-cost gate; ~23x
 # before it), with flight-recorder trace_phases attribution landing in
-# the report alongside the number.
+# the report alongside the number.  MULTI-LEVEL gates (N=256, 16
+# regions x 16 folding through branch=4 interior nodes, quorum-hub
+# leaves + region-ring downlink; FD-ceiling-checked, skipped only
+# when the soft limit cannot reach 4096): (5)
+# hier_round_ratio_256_over_64 <= 4 — the thousand-silo scaling gate
+# (per-level trace_phases + hier_level_ingress_256 name the guilty
+# tree level on a trip), (6) hier_root_egress_frac_256 <= 8 — root
+# bytes out stay ~O(branch·|model|), flat in N (the region-ring
+# downlink; O(N) coordinator fan-out would sit ~32x), and (7) the
+# seeded straggling-region chaos round completes with >= 1 per-region
+# quorum cutoff, ZERO abort-and-flatten fallbacks, and full
+# cross-party byte agreement (hier_chaos_fallbacks == 0,
+# hier_chaos_agree, hier_chaos_cutoffs >= 1).
 # LOCAL-LINK gates (transport/local.py, per-link backend upgrade):
 # local_link_vs_wire >= 2.0 — a colocated pair (shm handoff via
 # local_link="auto") must move the send-path payload shape at >= 2x

@@ -23,7 +23,9 @@ from rayfed_tpu.fl import fedavg
 from rayfed_tpu.fl import hierarchy as H
 from rayfed_tpu.fl import quantize as qz
 from rayfed_tpu.fl.streaming import StreamingAggregator
-from rayfed_tpu.transport.manager import TransportManager, partition_regions
+from rayfed_tpu.transport.manager import (
+    TransportManager, branch_groups, partition_regions,
+)
 
 from .multiproc import get_free_ports
 from .test_quantized_agg import _payload_of
@@ -89,6 +91,130 @@ def test_region_layout_dead_coordinator_fails_over_via_successor():
     assert lay4.active == [0] and lay4.root == "a"
     with pytest.raises(H.HierarchyRoundError, match="no live party"):
         H.region_layout(members, 2, dead=members)
+
+
+def test_branch_groups_full_id_range_contract():
+    """The interior-level grouping rule: parent = id // branch over the
+    FULL id range of the level — NOT dense re-packing of survivors —
+    so a node's parent never moves when a sibling subtree dies."""
+    assert branch_groups([0, 1, 2, 3, 4, 5, 6, 7], 2) == [
+        (0, [0, 1]), (1, [2, 3]), (2, [4, 5]), (3, [6, 7]),
+    ]
+    # Dead subtree (ids 4, 5 gone): survivors keep their parents, the
+    # emptied parent simply does not appear.
+    assert branch_groups([0, 1, 2, 3, 6, 7], 2) == [
+        (0, [0, 1]), (1, [2, 3]), (3, [6, 7]),
+    ]
+    # A lone high id still maps by id // branch (no re-indexing).
+    assert branch_groups([5], 4) == [(1, [5])]
+    # Input order must not matter.
+    assert branch_groups([7, 2, 0], 4) == [(0, [0, 2]), (1, [7])]
+    with pytest.raises(ValueError, match="branch"):
+        branch_groups([0, 1], 1)
+
+
+def test_relay_chains_bounded_and_even():
+    """Region-ring downlink chain splitting: order-preserving cover of
+    every member, no chain over RING_RELAY_MAX_HOPS, and the split is
+    even (the longest chain is the downlink's serial critical path)."""
+    members = [f"p{i:02d}" for i in range(33)]
+    chains = H._relay_chains(members)
+    assert [p for c in chains for p in c] == members
+    assert len(chains) == 5  # ceil(33 / 8)
+    assert max(len(c) for c in chains) <= H.RING_RELAY_MAX_HOPS
+    # Even split: longest and shortest differ by at most one hop.
+    assert max(len(c) for c in chains) - min(len(c) for c in chains) <= 1
+    # At or under the bound: one chain, untouched.
+    assert H._relay_chains(members[:8]) == [members[:8]]
+    assert H._relay_chains([]) == []
+    with pytest.raises(ValueError, match="max_hops"):
+        H._relay_chains(members, 0)
+
+
+def test_region_layout_multilevel_recursion_deterministic():
+    """N=16 at region_size=2, branch=2: 8 leaf regions fold through
+    interior levels of 4 and 2 nodes into the single top node — every
+    controller derives the identical tree from the sorted roster, and
+    coordinatorship is prefix-closed (an interior node's coordinator
+    is its first active child's)."""
+    members = [f"m{i:02d}" for i in range(16)]
+    lay = H.region_layout(members, 2, branch=2)
+    assert len(lay.regions) == 8 and lay.branch == 2
+    assert len(lay.levels) == 3
+    assert {n: nd.children for n, nd in lay.levels[0].items()} == {
+        0: (0, 1), 1: (2, 3), 2: (4, 5), 3: (6, 7),
+    }
+    assert {n: nd.children for n, nd in lay.levels[1].items()} == {
+        0: (0, 1), 1: (2, 3),
+    }
+    assert {n: nd.children for n, nd in lay.levels[2].items()} == {
+        0: (0, 1),
+    }
+    # Prefix-closure: level-1 coordinators are the first region
+    # coordinator of each pair; the top node's coordinator IS the root.
+    assert {n: nd.coordinator for n, nd in lay.levels[0].items()} == {
+        0: "m00", 1: "m04", 2: "m08", 3: "m12",
+    }
+    assert {n: nd.coordinator for n, nd in lay.levels[1].items()} == {
+        0: "m00", 1: "m08",
+    }
+    assert lay.levels[2][0].coordinator == lay.root == "m00"
+    # Pure function of the SORTED roster: shuffled input, same tree.
+    import random
+
+    shuffled = list(members)
+    random.Random(5).shuffle(shuffled)
+    assert H.region_layout(shuffled, 2, branch=2) == lay
+    # Wider branch, shallower tree: branch=4 folds 8 regions in two
+    # interior levels; a single-branch-group layout is the 2-level
+    # shape (one interior level, the top node).
+    lay4 = H.region_layout(members, 2, branch=4)
+    assert [sorted(level) for level in lay4.levels] == [[0, 1], [0]]
+    lay_flat = H.region_layout(members, 8)
+    assert len(lay_flat.levels) == 1
+    assert lay_flat.levels[0][0].children == (0, 1)
+    with pytest.raises(ValueError, match="branch"):
+        H.region_layout(members, 2, branch=1)
+
+
+def test_region_layout_multilevel_death_stability_and_epoch_churn():
+    """Interior parents derive from the FULL id range (id // branch),
+    so killing one subtree never re-parents another: with region 2
+    fully dead, level-1 node 1 keeps id 1 (lone child, successor
+    coordinator) while every other node is untouched.  An epoch
+    advance (roster actually shrinks) is a DIFFERENT derivation with a
+    different fingerprint — dead= pins the partition, churn re-derives
+    it."""
+    members = [f"m{i:02d}" for i in range(16)]
+    lay = H.region_layout(members, 2, branch=2)
+    dead = ["m06", "m07"]  # region 3, entirely
+    lay2 = H.region_layout(members, 2, dead=dead, branch=2)
+    assert lay2.regions == lay.regions  # partition pinned by dead=
+    assert lay2.active == [0, 1, 2, 4, 5, 6, 7]
+    assert {n: nd.children for n, nd in lay2.levels[0].items()} == {
+        0: (0, 1), 1: (2,), 2: (4, 5), 3: (6, 7),
+    }
+    # The lone survivor's parent kept its id and fell back to the
+    # surviving child's coordinator; upper levels are untouched.
+    assert lay2.levels[0][1].coordinator == "m04"
+    assert {n: nd.children for n, nd in lay2.levels[1].items()} == {
+        0: (0, 1), 1: (2, 3),
+    }
+    assert lay2.root == "m00"
+    # Root-side death climbs the whole prefix: with m00/m01 dead the
+    # root lease moves to region 1's coordinator at EVERY level.
+    lay3 = H.region_layout(members, 2, dead=["m00", "m01"], branch=2)
+    assert lay3.root == "m02"
+    assert lay3.levels[0][0].coordinator == "m02"
+    assert lay3.levels[2][0].coordinator == "m02"
+    # Epoch churn: the shrunk roster re-partitions (members shift
+    # across region boundaries) and the fingerprint moves with it.
+    after = [p for p in members if p not in dead]
+    lay_churn = H.region_layout(after, 2, branch=2)
+    assert lay_churn.regions != lay.regions
+    assert (
+        H.members_fingerprint(after) != H.members_fingerprint(members)
+    )
 
 
 def test_partial_sum_dtype_narrowest_exact():
@@ -281,9 +407,11 @@ class _Cluster:
 
     def run_round(self, contribs, grid, ref, *, region_size, keys,
                   weights=None, dead=(), stagger=None, epoch=None,
-                  quant_downlink=False, skip=()):
+                  quant_downlink=False, skip=(), **hier_kw):
         """Run one HierarchyRound on every (non-skipped) party thread;
-        returns ({party: result}, {party: exception})."""
+        returns ({party: result}, {party: exception}).  Extra keyword
+        arguments (``branch``/``region_quorum``/``region_deadline_s``/
+        ``ring_downlink``) pass straight through to HierarchyRound."""
         results, errors = {}, {}
 
         def run_party(p, i):
@@ -293,7 +421,7 @@ class _Cluster:
                     region_size=region_size, grid=grid, quant_ref=ref,
                     keys=keys, weights=weights, stream="ht",
                     backstop=60, dead=dead, epoch=epoch,
-                    quant_downlink=quant_downlink,
+                    quant_downlink=quant_downlink, **hier_kw,
                 )
                 if stagger:
                     time.sleep(stagger[i % len(stagger)])
@@ -452,6 +580,122 @@ def test_hierarchy_uneven_regions_single_member_region():
             ), p
     finally:
         c.stop()
+
+
+def test_hierarchy_multilevel_n8_bitexact_ring_and_hub():
+    """A REAL 3-level tree (N=8, region_size=2, branch=2: 4 leaf
+    regions -> 2 interior nodes -> top) is byte-identical to the
+    one-shot packed_quantized_sum, in BOTH leaf modes: the classic
+    stripe ring (+ region-ring downlink, the default) and the quorum
+    hub at full quorum — integer folds are exact + associative, so any
+    regrouping reassembles the flat accumulator exactly."""
+    parties = [f"t{i:02d}" for i in range(8)]
+    c = _Cluster(parties)
+    try:
+        n = 3_000
+        ref = np.zeros(n, np.float32)
+        tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+        grid = _grid_for(ref, seed=31)
+        weights = {
+            p: float(w)
+            for p, w in zip(parties, [3, 1, 2, 5, 1, 2, 1, 4])
+        }
+        contribs = _contribs(parties, ref, tmpl, seed0=700)
+        qts = [
+            qz.quantize_packed(contribs[p], grid, ref=ref)
+            for p in parties
+        ]
+        want = fedavg.packed_quantized_sum(
+            qts, [weights[p] for p in parties], ref=ref
+        )
+        cutoffs0 = H.HIER_STATS["region_cutoffs"]
+        for tag, kw in [
+            ("ring", dict(branch=2)),
+            ("hub", dict(branch=2, region_quorum=2)),
+            ("fan", dict(branch=2, ring_downlink=False)),
+        ]:
+            results, errors = c.run_round(
+                contribs, grid, ref, region_size=2,
+                keys=[f"m{tag}{j}" for j in range(6)],
+                weights=weights, **kw,
+            )
+            assert not errors, (tag, errors)
+            for p in parties:
+                assert (
+                    np.asarray(results[p].buf).tobytes()
+                    == np.asarray(want.buf).tobytes()
+                ), f"{p} [{tag}]: multi-level != one-shot"
+        # Full-quorum hub mode saw every member arrive: no cutoffs.
+        assert H.HIER_STATS["region_cutoffs"] == cutoffs0
+    finally:
+        c.stop()
+
+
+def test_hierarchy_region_quorum_cutoff_absorbs_dead_member():
+    """THE per-region cutoff contract: one region member is silent
+    (process never joined — a partially-dead region), the region's
+    deadline-gated hub fold contributes the ARRIVED subset's partial
+    sum, and the root reweights to the true arrived Σw — the round
+    COMPLETES (no abort, no flatten-fallback), every live party
+    byte-agrees with packed_quantized_sum over the arrived subset."""
+    parties = [f"x{i:02d}" for i in range(6)]
+    silent = "x04"  # region 1 member (x03 coordinates x03..x05)
+    c = _Cluster(parties)
+    try:
+        n = 3_000
+        ref = np.zeros(n, np.float32)
+        tmpl = fl_comp.pack_tree({"w": jnp.asarray(ref)}, jnp.float32)
+        grid = _grid_for(ref, seed=41)
+        # The silent member carries the LARGEST weight, so a root that
+        # divided by the roster Σw instead of the arrived Σw would be
+        # loudly wrong.
+        weights = {
+            p: float(w) for p, w in zip(parties, [2, 1, 3, 1, 5, 2])
+        }
+        contribs = _contribs(parties, ref, tmpl, seed0=800)
+        cutoffs0 = H.HIER_STATS["region_cutoffs"]
+        aborted0 = H.HIER_STATS["rounds_aborted"]
+        results, errors = c.run_round(
+            contribs, grid, ref, region_size=3,
+            keys=[f"rq{j}" for j in range(6)], weights=weights,
+            skip=(silent,), region_quorum=2, region_deadline_s=1.0,
+        )
+        assert not errors, errors
+        assert H.HIER_STATS["region_cutoffs"] == cutoffs0 + 1
+        assert H.HIER_STATS["rounds_aborted"] == aborted0
+        arrived = [p for p in parties if p != silent]
+        qts = [
+            qz.quantize_packed(contribs[p], grid, ref=ref)
+            for p in arrived
+        ]
+        want = fedavg.packed_quantized_sum(
+            qts, [weights[p] for p in arrived], ref=ref
+        )
+        blobs = {
+            p: np.asarray(results[p].buf).tobytes() for p in arrived
+        }
+        assert len(set(blobs.values())) == 1, "parties disagree"
+        assert blobs[arrived[0]] == np.asarray(want.buf).tobytes(), (
+            "cutoff aggregate != packed_quantized_sum over the "
+            "arrived subset"
+        )
+    finally:
+        c.stop()
+
+
+def test_hierarchy_region_quorum_validation():
+    ref, packeds, grid = _toy_round(2)
+    with pytest.raises(ValueError, match="region_quorum"):
+        H.HierarchyRound(
+            object(), party="a", members=["a", "b"], region_size=2,
+            grid=grid, quant_ref=ref, keys=["k"] * 6, region_quorum=0,
+        )
+    with pytest.raises(ValueError, match="needs region_quorum"):
+        H.HierarchyRound(
+            object(), party="a", members=["a", "b"], region_size=2,
+            grid=grid, quant_ref=ref, keys=["k"] * 6,
+            region_deadline_s=1.0,
+        )
 
 
 def test_hierarchy_refuses_passthrough_and_unquantized():

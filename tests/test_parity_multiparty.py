@@ -8,6 +8,7 @@ Mirrors the reference suite's object-passing semantics
 """
 
 import numpy as np
+import pytest
 
 from tests.multiproc import make_cluster, run_parties
 
@@ -42,6 +43,13 @@ def run_basic_pass(party, cluster):
     fed.shutdown()
 
 
+# Tier-1 budget: this leg is a strict subset of
+# test_pass_fed_objects_in_containers below (the same bidirectional
+# producer/consumer pass over the same 2-party subprocess fixture,
+# bare values instead of containers), at ~13 s of party-child spawn
+# cost — the container leg and the 3-party broadcast leg keep the
+# machinery covered in tier-1.
+@pytest.mark.slow
 def test_basic_pass_fed_objects():
     run_parties(run_basic_pass, ["alice", "bob"], args=(CLUSTER_AB,))
 

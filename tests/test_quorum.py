@@ -354,6 +354,12 @@ def _run_parity(party, cluster, outdir):
     hier = run_fedavg_rounds(
         trainers, params, rounds=3, compress_wire=True, packed_wire=True,
         mode="hierarchy", region_size=1, wire_quant="uint8",
+        # region_branch threads through the quorum loop to
+        # region_layout (2 singleton regions under one branch-2
+        # interior node — the identical tree the default derives, so
+        # the byte-agreement assertions below also pin the explicit
+        # multi-level path against it).
+        region_branch=2,
         # The chunk override must reach the quorum loop's grid
         # derivation too (a default-chunked grid over this toy model
         # would collapse to one block).

@@ -177,6 +177,7 @@ def compute_fingerprint() -> str:
         "rs", region=1, n_regions=4, stripe=0, n_stripes=2, nblocks=9,
         total_elems=1 << 21, dtype="uint8", qgrid_fp=12345,
         members_fp=hierarchy.members_fingerprint(["a", "b"]), epoch=3,
+        level=0, parent=0, path="0/0",
     )
 
     # Shared quantization grid (compressed-domain aggregation,

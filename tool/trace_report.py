@@ -48,7 +48,51 @@ _EPS = 1e-9
 
 # Zero-duration record families surfaced in the per-round event list.
 _EVENT_PREFIXES = ("chaos.", "quorum.", "blob.failover", "ring.abort",
-                   "hier.abort")
+                   "hier.abort", "hier.region_cutoff")
+
+
+def _hier_level(phase: str) -> Optional[str]:
+    """Tree-level attribution label for a ``hier.*`` phase span, or
+    None for non-hierarchy phases.
+
+    The hierarchy driver stamps the level into the span name itself
+    (``hier.up.l2`` = the fold INTO level-2 interior nodes,
+    ``hier.down.l1`` = the fan-down FROM level-1 coordinators), so an
+    N=256 ratio-gate failure localizes to a tree level straight from
+    the bench's ``trace_phases`` block — no per-party log digging.
+    Leaf phases (``region_rs``/``region_gather``) map to ``leaf``; the
+    in-region broadcast phases (``down.relay``/``down.fan``/
+    ``broadcast``) map to ``leaf.down``; everything else (``commit``)
+    keeps its own name.
+    """
+    if not phase.startswith("hier."):
+        return None
+    name = phase[len("hier."):]
+    if name in ("region_rs", "region_gather"):
+        return "leaf"
+    if name in ("down.relay", "down.fan", "broadcast"):
+        return "leaf.down"
+    for stem in ("up.l", "down.l"):
+        if name.startswith(stem):
+            lv = name[len(stem):]
+            if lv.isdigit():
+                return f"l{lv}.{'up' if stem == 'up.l' else 'down'}"
+    return name
+
+
+def hier_level_attribution(
+    chain: Sequence[Dict[str, Any]],
+) -> Dict[str, float]:
+    """Critical-path seconds per tree level: ``hier.*`` chain segments
+    grouped by :func:`_hier_level` label, sorted by descending blame."""
+    levels: Dict[str, float] = {}
+    for seg in chain:
+        label = _hier_level(str(seg.get("phase", "")))
+        if label is not None:
+            levels[label] = levels.get(label, 0.0) + float(seg["dur_s"])
+    return dict(
+        sorted(levels.items(), key=lambda kv: kv[1], reverse=True)
+    )
 
 
 def load_records(doc: Any) -> List[Dict[str, Any]]:
@@ -178,8 +222,10 @@ def round_report(
     (the slowest party's own ``driver.round`` measurement, None when no
     driver span was collected), ``wall_agrees`` (the two reconcile
     within ``tolerance``, relative), ``chain`` (critical-path
-    segments), ``bounded_by`` (the chain's largest segment),
-    ``straggler`` (largest ``local_s``), and ``events``."""
+    segments), ``hier_levels`` (critical-path seconds per hierarchy
+    tree level, empty for non-hierarchy rounds), ``bounded_by`` (the
+    chain's largest segment), ``straggler`` (largest ``local_s``), and
+    ``events``."""
     out: Dict[int, Dict[str, Any]] = {}
     records = list(records)
     for rnd in rounds_of(records):
@@ -217,6 +263,7 @@ def round_report(
             "driver_wall_s": driver_wall,
             "wall_agrees": agrees,
             "chain": chain,
+            "hier_levels": hier_level_attribution(chain),
             "bounded_by": bounded,
             "straggler": straggler,
             "straggler_local_s": local_best,
@@ -262,6 +309,13 @@ def format_report(
             lines.append(
                 f"  straggler {info['straggler']} "
                 f"(local {info['straggler_local_s'] * 1e3:.1f} ms)"
+            )
+        if info["hier_levels"]:
+            lines.append(
+                "  hierarchy levels: " + "  ".join(
+                    f"{lbl} {dur * 1e3:.1f} ms"
+                    for lbl, dur in info["hier_levels"].items()
+                )
             )
         for seg in info["chain"]:
             lines.append(
