@@ -252,6 +252,15 @@ def init(
     runtime.recv_proxy = transport
     runtime.transport = transport
 
+    # Pre-warm the fl package ON THIS THREAD, before any cross-thread
+    # traffic exists: metrics_snapshot() and the encode/decode paths
+    # all lazy-import fl submodules from worker threads, and two FIRST
+    # imports racing across threads can observe a partially initialized
+    # package (import deadlock-avoidance surfaces as KeyError
+    # 'rayfed_tpu.fl' / "partially initialized module").  One eager
+    # import here makes every later lookup a sys.modules hit.
+    import rayfed_tpu.fl  # noqa: F401
+
     if enable_waiting_for_other_parties_ready:
         ping_others(cluster=cluster, self_party=party, max_retries=3600)
     logger.info("Started rayfed_tpu runtime for party %s.", party)

@@ -63,6 +63,18 @@ Ops:
   driver-level hooks (``round``, ``announce``): the test/bench harness
   turns it into a hard process exit (or, in-process, an abrupt
   transport stop) so peers see sockets die, not a graceful goodbye.
+- ``local_slowdown`` — a per-party COMPUTE-delay **multiplier** at the
+  ``local_step`` hook: the hook site reports how long the party's local
+  step actually took (``baseline_s``), and the rule stretches it to
+  ``value`` times that (sleeping ``baseline_s * (value - 1)``).
+  ``value`` is the multiplier (or a two-element ``[lo, hi]`` drawn
+  uniformly from the rule's seeded rng — deterministic per rule, so a
+  "2-10x straggler spread" schedule replays identically).  Unlike
+  ``delay_ms`` (an absolute stall), a multiplier scales with the real
+  compute, which is what heterogeneous-device fleets look like — the
+  async round gate and the quorum/hierarchy straggler tests share one
+  schedule format.  Persists by default (``count`` unbounded): a slow
+  device stays slow.
 - ``partition`` — bidirectional frame drop between the two parties
   named by ``value: [a, b]``.  Fires at the ``wire`` hook (every
   client-side frame incl. health pings and handshakes, and every
@@ -101,6 +113,11 @@ Hook catalog (:data:`HOOKS`) — ``hook name: (site, context fields)``:
   successor to re-establish the round from re-pushed contributions.
 - ``republish`` — the multi-host leader's bridge republish
   (``pid``, ``up``, ``down``): ``drop_frame``, ``delay_ms``.
+- ``local_step`` — a party's local-compute step boundary (the async
+  round loop's virtual parties, reusable by any driver that measures
+  its own compute): context carries ``round`` (or ``version``) and
+  ``baseline_s`` — the measured duration of the step just taken.
+  ``local_slowdown`` (multiplier), ``delay_ms``, ``crash_party``.
 """
 
 from __future__ import annotations
@@ -127,11 +144,16 @@ HOOKS = (
     # secure-round window (only failover can finish the round, and the
     # successor must re-run recovery on its own stream).
     "secagg_recovery",
+    # A party's local-compute step boundary (async virtual parties and
+    # any driver that measures its own compute) — the hook that makes
+    # deterministic heterogeneous-speed fleets (2-10x straggler spread)
+    # first-class via the local_slowdown multiplier op.
+    "local_step",
 )
 
 _OPS = (
     "delay_ms", "drop_frame", "corrupt_crc", "kill_rail", "crash_party",
-    "partition",
+    "partition", "local_slowdown",
 )
 
 
@@ -172,8 +194,12 @@ class _Rule:
         self.match = dict(spec.get("match") or {})
         self.after = int(spec.get("after", 0))
         # A partition is a standing condition, not an event — it stays
-        # up until explicitly bounded (count) or uninstalled.
-        count = spec.get("count", None if self.op == "partition" else 1)
+        # up until explicitly bounded (count) or uninstalled.  So is a
+        # local_slowdown: a slow device stays slow.
+        count = spec.get(
+            "count",
+            None if self.op in ("partition", "local_slowdown") else 1,
+        )
         self.count = None if count is None else int(count)
         self.value = spec.get("value")
         if self.op == "partition":
@@ -187,6 +213,20 @@ class _Rule:
                     f"two distinct parties, got {self.value!r}"
                 )
             self.value = [str(p) for p in self.value]
+        if self.op == "local_slowdown":
+            v = self.value
+            ok = (
+                isinstance(v, (int, float)) and float(v) >= 1.0
+            ) or (
+                isinstance(v, (list, tuple)) and len(v) == 2
+                and all(isinstance(x, (int, float)) for x in v)
+                and 1.0 <= float(v[0]) <= float(v[1])
+            )
+            if not ok:
+                raise ValueError(
+                    "local_slowdown op needs value=<multiplier >= 1> or "
+                    f"value=[lo, hi] with 1 <= lo <= hi, got {v!r}"
+                )
         self.seen = 0
         self.fired = 0
         # Rule-local deterministic rng (e.g. delay drawn from [lo, hi]):
@@ -219,6 +259,13 @@ class _Rule:
         if isinstance(v, (list, tuple)) and len(v) == 2:
             v = self.rng.uniform(float(v[0]), float(v[1]))
         return float(v or 0) / 1e3
+
+    def slowdown(self) -> float:
+        """The compute-delay multiplier (seeded draw for [lo, hi])."""
+        v = self.value
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            v = self.rng.uniform(float(v[0]), float(v[1]))
+        return max(1.0, float(v))
 
 
 class ChaosSchedule:
@@ -316,6 +363,22 @@ def _apply(rule: _Rule, hook: str, party: Optional[str],
         logger.warning("%s party=%s delaying %.0f ms (ctx=%s)",
                        label, party, delay * 1e3, _ctx_brief(ctx))
         return delay
+    if rule.op == "local_slowdown":
+        # Multiplier semantics: the hook site reports how long the local
+        # step ACTUALLY took (baseline_s); stretching it to m x means
+        # sleeping the remaining (m - 1) share.  A site that passes no
+        # baseline gets no stall (logged) — absolute stalls are what
+        # delay_ms is for.
+        mult = rule.slowdown()
+        base = float(ctx.get("baseline_s") or 0.0)
+        stall = max(0.0, base * (mult - 1.0))
+        if rule.fired <= 3 or base <= 0.0:
+            logger.warning(
+                "%s party=%s x%.2f over baseline %.3fs -> stalling "
+                "%.3fs (ctx=%s)", label, party, mult, base, stall,
+                _ctx_brief(ctx),
+            )
+        return stall
     if rule.op == "partition":
         # A standing partition fires on every frame — log its onset, not
         # a warning per dropped ping.

@@ -24,6 +24,12 @@ Here the common algorithms ship with the framework:
   partial-sum streaming (``run_fedavg_rounds(mode="hierarchy",
   region_size=...)``); byte-identical to the flat compressed-domain
   fold, per-party traffic flat in N.
+- :mod:`async_rounds` — buffered asynchronous rounds (FedBuff-style):
+  parties push staleness-tagged quantized deltas whenever local work
+  finishes; the coordinator folds each arrival into a running
+  donated-i32 buffer with exact integer-shift staleness decay and
+  emits a new model version every K contributions or T seconds
+  (``fl.run_async_fleet(...)``).
 - :mod:`dp` — differential privacy: global-norm clipping + Gaussian
   noise on outgoing updates.
 - :mod:`robust` — Byzantine-robust aggregation (coordinate median,
@@ -60,6 +66,13 @@ from rayfed_tpu.fl.hierarchy import (
     hierarchy_aggregate,
 )
 from rayfed_tpu.fl.overlap import PipelinedRoundRunner, dga_correct
+from rayfed_tpu.fl.async_rounds import (
+    AsyncBuffer,
+    decay_weight,
+    run_async_coordinator,
+    run_async_fleet,
+    run_async_party,
+)
 from rayfed_tpu.fl.quorum import (
     QuorumRoundError,
     quorum_aggregate,
@@ -123,6 +136,11 @@ __all__ = [
     "run_quorum_rounds",
     "PipelinedRoundRunner",
     "dga_correct",
+    "AsyncBuffer",
+    "decay_weight",
+    "run_async_coordinator",
+    "run_async_fleet",
+    "run_async_party",
     "StreamingAggregator",
     "StripeAggregator",
     "ErrorFeedback",

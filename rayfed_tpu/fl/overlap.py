@@ -50,25 +50,61 @@ identically) — either way a round is never silently skipped.
 :class:`PipelinedRoundRunner` is the engine underneath for callers that
 want to drive rounds themselves.
 
-Why ``server_opt`` stays a LOUD exclusion (fl.server_opt shipped the
-packed step for every *synchronous* topology): the DGA recurrence above
-is exactly FedAvg **because** the broadcast is the plain aggregate —
-``agg_k + (w_local − w_local_at_send)`` resyncs onto the mean and
-preserves local progress verbatim.  With a server step the broadcast is
-``x_{k+1} = step(x_k, agg_k)``; applying the correction to it would add
-one-round-stale RAW deltas on top of an already-stepped (momentum-
-scaled) model — the composed update is ``step(x, agg) + Δ`` where the
-synchronous recurrence wants ``step(x, agg + Δ/N)``-shaped terms, and
-the two only agree when the step is the identity.  Deriving the
-staleness-adjusted accelerated recurrence (the analogue of the
-quantized-DGA derivation, ROADMAP item 2b) is open work; until then the
-driver refuses the pair instead of silently training a different
-algorithm.  (The QUORUM loop's straggler late fold — the same
-``dga_correct`` call — is a different animal and composes deliberately:
-it is exceptional-path-only and bounded to one straggler-round of local
-work, which reaches the optimizer one round late inside the NEXT
-round's pseudo-gradient rather than recomposing every round's
-broadcast; see ``docs/source/server_optimization.rst``.)
+**The unified staleness recurrence** (ROADMAP item 1a, shipped here) is
+what lets the correction compose with delta-grid coding
+(``wire_quant``) and with the accelerated server step (``server_opt``)
+— both were loud exclusions until the following two observations:
+
+*Overlap x wire_quant.*  Write ``b_{k-1}`` for the round-(k−1)
+broadcast (the value every controller byte-agrees on).  Round *k*'s
+corrected contribution is ``c_p = b_{k-1} + (u_p − c_p^{prev})``, so
+its delta against the round's shared reference — which IS ``b_{k-1}``,
+exactly as in the synchronous quantized loop — is::
+
+    c_p − b_{k-1}  =  u_p − c_p^{prev}
+
+i.e. the party's *local displacement over one round of training*: the
+same quantity whose scale the synchronous loop's delta grid is ranged
+for (previous aggregate delta x ``QUANT_DELTA_EXPAND`` headroom).  The
+DGA correction therefore **commutes with delta-grid coding**: quantize
+the corrected contribution against the broadcast reference and you have
+coded the raw displacement, bit for bit (``dga_correct`` computes in
+f32 and casts once to the wire dtype, so no intermediate rounding
+intrudes).  The runner derives the round grid from the previous
+broadcast delta — the identical shared-buffer derivation as
+``run_fedavg_rounds``'s classic loop — with round 0 unquantized
+(bootstrap, nothing observed yet), and hands ``quant/quant_ref/
+quant_scope`` to the very same collective codepaths
+(``streaming_aggregate`` / ``ring_aggregate``), RoundCodec EF
+discipline included.
+
+*Overlap x server_opt.*  With a packed server step the broadcast is
+``b_k = step(x_k, m_k)`` where ``m_k = mean_p c_p`` is the finalized
+mean.  Anchor the correction on that post-step broadcast —
+``c_p ← b_{k-1} + (u_p − c_p^{prev})``, literally the same
+``dga_correct`` call — and take means::
+
+    m_k − b_{k-1}  =  mean_p u_p − m_{k-1}
+
+The step's pseudo-gradient ``x_k − m_k`` therefore consumes exactly the
+**mean one-round-stale local displacement**: the accelerated recurrence
+runs on delayed gradients (the delayed-gradient regime Federated
+Accelerated SGD analyzes, arXiv:2006.08950) instead of silently
+composing ``step(x, agg) + Δ`` as a naive pairing would.  Mechanically
+the runner passes the finalize-side step hook into the collective (the
+coordinator steps the exact finalized f32 once; ring rounds step the
+byte-identical assembly locally on every controller) and resyncs the
+replicated optimizer state from each landed broadcast pair — the same
+state-without-a-state-broadcast contract as every synchronous topology
+(fl.server_opt).  Both compositions are verified bit-exactly by
+in-process replays in ``tests/test_overlap.py`` (see the composition
+matrix rows).  (The QUORUM loop's straggler late fold — the same
+``dga_correct`` call — composes the same way one level down: the missed
+contribution reaches the optimizer one round late inside the NEXT
+round's pseudo-gradient; see ``docs/source/server_optimization.rst``.)
+This recurrence is also the prerequisite the buffered asynchronous
+driver builds on — ``fl/async_rounds.py`` runs it at per-party
+staleness instead of the uniform one-round lag.
 """
 
 from __future__ import annotations
@@ -171,6 +207,18 @@ class PipelinedRoundRunner:
     with the overlap because the lane only needs a blocking collective
     call with pre-allocated seq ids.
 
+    ``wire_quant``: optional integer wire dtype name (``"uint8"`` /
+    ``"uint16"``) — rounds run compressed-domain exactly like the
+    synchronous quantized loop (delta grid derived from the previous
+    broadcast delta, round 0 unquantized bootstrap, scoped
+    error-feedback residual under ``stream``); the unified staleness
+    recurrence (module docstring) is why the corrected contribution
+    codes exactly.  ``server_opt``: optional packed server optimizer
+    (:class:`~rayfed_tpu.fl.server_opt.PackedServerOptimizer`, or the
+    bare packed spec, which gets wrapped) — the broadcast becomes the
+    post-step model and the step consumes the mean one-round-stale
+    local displacement as its pseudo-gradient.
+
     Every controller constructs the runner with identical arguments and
     calls :meth:`run` at the same program point (the usual
     multi-controller contract).
@@ -187,6 +235,8 @@ class PipelinedRoundRunner:
         stream: str = "fedavg",
         on_round: Optional[Callable[[int, Any], None]] = None,
         ring_chunk_elems: Optional[int] = None,
+        wire_quant: Optional[str] = None,
+        server_opt: Any = None,
     ) -> None:
         if not trainers:
             raise ValueError("PipelinedRoundRunner needs trainers")
@@ -215,6 +265,14 @@ class PipelinedRoundRunner:
         self._stream = stream
         self._on_round = on_round
         self._ring_chunk_elems = ring_chunk_elems
+        self._wire_quant = None if wire_quant is None else str(wire_quant)
+        if server_opt is not None and not hasattr(server_opt, "step_fn"):
+            # Convenience for direct-runner callers: accept the bare
+            # packed spec and wrap it the way run_fedavg_rounds does.
+            from rayfed_tpu.fl.server_opt import PackedServerOptimizer
+
+            server_opt = PackedServerOptimizer(server_opt)
+        self._sopt = server_opt
         # The local controller's party — set by run() (the runtime is
         # not required at construction time); stamps the flight
         # recorder's driver.round / overlap.hidden spans.
@@ -229,10 +287,17 @@ class PipelinedRoundRunner:
         seq_ids: Sequence[int],
         fallback_ids: Sequence[int],
         rec: Dict[str, float],
+        grid: Any = None,
+        ref: Any = None,
+        step_fn: Optional[Callable[[Any], Any]] = None,
     ) -> Any:
         from rayfed_tpu.fl.ring import RING_STATS, RingRoundError, ring_aggregate
         from rayfed_tpu.fl.streaming import streaming_aggregate
 
+        # Under a server step the aggregate must come back f32 (the
+        # step's pseudo-gradient lives below bf16 resolution); quant
+        # rounds finalize f32 already.
+        out_dtype = "float32" if step_fn is not None else None
         t0 = time.perf_counter()
         try:
             if self._mode != "ring":
@@ -248,13 +313,31 @@ class PipelinedRoundRunner:
                     objs, self._weights, stream=self._stream,
                     coordinator=self._coord, seq_ids=seq_ids,
                     round_tag=r, timings=rec,
+                    out_dtype=out_dtype,
+                    quant=grid, quant_ref=ref,
+                    quant_scope=self._stream if grid is not None else None,
+                    # Quantize the result broadcast too — the downlink
+                    # is the other half of the round's bytes (same as
+                    # the synchronous quantized loop).
+                    quant_downlink=grid is not None,
+                    server_step=step_fn,
                 )
             try:
-                return ring_aggregate(
+                agg = ring_aggregate(
                     objs, self._weights, stream=self._stream,
                     chunk_elems=self._ring_chunk_elems,
                     seq_ids=seq_ids, round_tag=r, timings=rec,
+                    out_dtype=out_dtype,
+                    quant=grid, quant_ref=ref,
+                    quant_scope=self._stream if grid is not None else None,
                 )
+                if step_fn is not None:
+                    # The ring has no downlink — every controller holds
+                    # the byte-identical assembled aggregate, so each
+                    # applies the same deterministic f32 step locally
+                    # and all byte-agree on the post-step model.
+                    agg = step_fn(agg)
+                return agg
             except RingRoundError as exc:
                 # The abort reached every controller (poison cascade +
                 # commit ring — ring_aggregate's contract, peer death
@@ -275,6 +358,15 @@ class PipelinedRoundRunner:
                     objs, self._weights, stream=self._stream,
                     coordinator=self._coord, seq_ids=fallback_ids,
                     round_tag=r, timings=rec,
+                    out_dtype=out_dtype,
+                    # Same grid, same (uncommitted) residual: the
+                    # fallback re-quantizes the identical codes the ring
+                    # round would have folded.  Downlink stays plain —
+                    # recovery path, keep it simple.  The server step
+                    # re-runs from the same (never-resynced) state.
+                    quant=grid, quant_ref=ref,
+                    quant_scope=self._stream if grid is not None else None,
+                    server_step=step_fn,
                 )
         finally:
             # Raw lane window (fallback included).  The lane job BLOCKS
@@ -426,6 +518,26 @@ class PipelinedRoundRunner:
         backstop = runtime.job_config.recv_backstop_s
         parties = list(self._trainers)
         outgoing = compress(params, packed=True, wire_dtype=self._wire_dtype)
+        # Compressed-domain / server-opt round state (the unified
+        # staleness recurrence, module docstring).  ``round_base`` is
+        # the f32 reference every controller byte-agrees on for the
+        # round about to be SUBMITTED (round 0: the f32 pack of the
+        # init; later: the f32 view of the latest landed broadcast);
+        # ``inflight_base`` anchors the IN-FLIGHT round's step/grid so
+        # the optimizer resync and the next grid derivation use the
+        # matching broadcast pair when that round lands.
+        sopt = self._sopt
+        use_quant = self._wire_quant is not None
+        round_base = None
+        inflight_base = None
+        prev_delta = None
+        if use_quant or sopt is not None:
+            import jax.numpy as jnp
+            import numpy as _np
+
+            from rayfed_tpu.fl.compression import pack_tree
+
+            round_base = _np.asarray(pack_tree(params, jnp.float32).buf)
         lane = CommsLane(
             name=f"rayfed-comms-{me}",
             bind_runtime_fn=runtime._bind_to_current_thread,
@@ -475,6 +587,24 @@ class PipelinedRoundRunner:
                     # DGA correction as a party-local fed task chained
                     # on the round-r train output.
                     agg_prev = self._collect(inflight, backstop, u_done)
+                    if use_quant or sopt is not None:
+                        new_base = _np.asarray(agg_prev.buf).astype(
+                            _np.float32
+                        )
+                        if sopt is not None:
+                            # Every controller advances its state
+                            # replica from the landed round's
+                            # byte-agreed broadcast pair — zero extra
+                            # wire bytes (fl.server_opt).
+                            sopt.resync(
+                                inflight_base, _np.asarray(agg_prev.buf)
+                            )
+                        if use_quant:
+                            # What the grid must cover next round: how
+                            # far the global model just moved (under
+                            # server_opt: the POST-step delta).
+                            prev_delta = new_base - round_base
+                        round_base = new_base
                     if self._on_round is not None:
                         self._on_round(
                             inflight.round_index, decompress(agg_prev)
@@ -493,12 +623,44 @@ class PipelinedRoundRunner:
                                 "local_s", time.perf_counter() - t0
                             )
                         )
+                # Round-r grid/step, derived from broadcast values only
+                # (bit-identical on every controller).  The FIRST round
+                # has no observed delta yet and runs unquantized
+                # (bootstrap) — exactly the synchronous quantized loop.
+                round_grid = None
+                if use_quant and prev_delta is not None:
+                    from rayfed_tpu.fl import quantize as _qz
+
+                    round_grid = _qz.make_round_grid(
+                        prev_delta, wire_dtype=self._wire_quant,
+                        mode="delta",
+                        # The grid chunking must BE the ring's stripe
+                        # chunking, or ring_aggregate's chunk-match
+                        # guard would abort (and fall back) every
+                        # quantized round.
+                        chunk_elems=(
+                            self._ring_chunk_elems
+                            if self._mode == "ring" else None
+                        ),
+                        # Per-party deltas overshoot the aggregate
+                        # delta; what still clips rides the EF
+                        # residual.
+                        expand=_qz.QUANT_DELTA_EXPAND,
+                    )
+                step_fn = None
+                if sopt is not None:
+                    sopt.ensure(round_base)
+                    step_fn = sopt.step_fn(round_base)
+                inflight_base = round_base
                 seq_ids, fallback_ids = self._alloc_ids(runtime)
                 inflight = _InFlight(
                     r,
                     lane.submit(
                         self._aggregate_round, r, list(contribs.values()),
                         seq_ids, fallback_ids, rec,
+                        round_grid,
+                        round_base if use_quant else None,
+                        step_fn,
                     ),
                     rec,
                 )

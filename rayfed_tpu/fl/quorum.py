@@ -724,8 +724,11 @@ def run_quorum_rounds(
       carried grid shared with ``streaming_aggregate`` — quantized-
       quorum and quantized-streaming rounds are byte-identical).  A
       joiner's welcome carries the current grid reference delta, so
-      elastic membership composes.  Coordinator topology only
-      (``mode="ring"`` + ``wire_quant`` is a loud exclusion).
+      elastic membership composes.  ``mode="ring"`` composes too: the
+      quorum ring quantizes on the shared round grid (the grid
+      chunking doubles as the stripe grid), and a ring abort falls
+      back to the flat quantized quorum round with the same
+      uncommitted error-feedback residual.
     - ``secure_agg``: mask the quantized contributions with pairwise
       masks derived from the transport's HELLO key agreement
       (:mod:`rayfed_tpu.fl.secagg`) — the coordinator learns only the
@@ -761,13 +764,6 @@ def run_quorum_rounds(
         raise QuorumRoundError(
             "this transport has no roster (quorum rounds need the "
             "single-process TransportManager or a multi-host leader)"
-        )
-    if wire_quant is not None and mode == "ring":
-        raise QuorumRoundError(
-            "quantized quorum rounds run the coordinator topology — "
-            "mode='ring' with wire_quant is a loud exclusion (the "
-            "quorum ring has not been taught the quantized stripe "
-            "shape), never a silent fallback"
         )
     if mode == "hierarchy":
         if wire_quant is None:
@@ -1016,15 +1012,18 @@ def run_quorum_rounds(
                 round_grid = qz.make_round_grid(
                     quant_prev_delta, wire_dtype=wire_quant,
                     mode="delta", expand=qz.QUANT_DELTA_EXPAND,
-                    # The grid chunking IS the hierarchy's region
-                    # stripe chunking (ring_chunk_elems doubles as the
-                    # override, exactly as in the classic loop) — a
-                    # default-chunked grid over a small model would
-                    # collapse to ~1 block and degenerate every region
-                    # ring to a single stripe owner.
+                    # The grid chunking IS the topology's stripe/chunk
+                    # grid (ring_chunk_elems doubles as the override,
+                    # exactly as in the classic loop): the quorum ring
+                    # quantizes on this same grid or ring_aggregate's
+                    # chunk-match guard would abort (and fall back)
+                    # every quantized round, and a default-chunked grid
+                    # over a small model would collapse to ~1 block and
+                    # degenerate every region ring to a single stripe
+                    # owner.
                     chunk_elems=(
-                        ring_chunk_elems if mode == "hierarchy"
-                        else None
+                        ring_chunk_elems
+                        if mode in ("ring", "hierarchy") else None
                     ),
                 )
         # Server optimization (fl.server_opt): the round's shared
@@ -1323,15 +1322,6 @@ def _aggregate_with_mode(
 
     me = runtime.party
     down = _round_key(session, stream, round_index)
-    if quant is not None and mode == "ring":
-        # Loud exclusion, never a silent fallback: the quorum ring has
-        # not been taught the quantized round shape (the grid chunking
-        # vs ring stripe grid interaction) — the driver validates this
-        # up front, so reaching here is a programming error.
-        raise QuorumRoundError(
-            "quantized quorum rounds run the coordinator topology — "
-            "mode='ring' with quant= is not supported"
-        )
 
     def _announce_after_topology(result) -> QuorumRoundOutcome:
         """Roster transition after a successful ring/hierarchy round:
@@ -1408,6 +1398,16 @@ def _aggregate_with_mode(
                 timeout=deadline_s if deadline_s is not None else backstop,
                 expect_parties=active,
                 timings=timings,
+                # Compressed-domain quorum ring (ROADMAP item 1c, the
+                # last topology exclusion lifted): the PR 12 quantized
+                # stripe machinery — chunk-grid match guard, quantized
+                # gather hop, RoundCodec EF discipline — needs no
+                # quorum-specific teaching, and the RingRoundError
+                # fallback below re-codes the SAME (uncommitted)
+                # residual through the flat quorum path's shared codec.
+                quant=quant,
+                quant_ref=quant_ref,
+                quant_scope=quant_scope,
             )
             if server_step is not None:
                 # The ring has no downlink — every controller already

@@ -61,15 +61,19 @@ the step consumes exactly that subset mean), ``mode="ring"`` and
 ``mode="hierarchy"`` (state steps once, at the root, and the tree
 broadcast carries the post-step model) and ``checkpointer`` (snapshots
 carry the state plus a spec stamp; restoring across differing specs is
-refused loudly).  ``overlap=True`` stays a LOUD exclusion: the DGA
-correction ``agg + (w − w_at_send)`` assumes the broadcast IS the
-aggregate — a server step in between breaks the recurrence (the
-correction would re-apply local deltas on top of an already-stepped
-model), and the staleness-adjusted step has no derivation yet (cf. the
-quantized-DGA open item).  ``secure_agg`` and elastic ``join_ticket``
-entry are loud exclusions too (the masked recovery window has not been
-exercised with a post-finalize step; welcomes do not carry server-opt
-state) — never silent fallbacks.
+refused loudly).  ``overlap=True`` composes too, via the unified
+staleness recurrence (``fl/overlap.py`` module docstring): anchoring
+the DGA correction ``agg + (w − w_at_send)`` on the POST-step broadcast
+makes the step's pseudo-gradient the mean one-round-stale local
+displacement — the delayed-gradient regime Federated Accelerated SGD
+analyzes — and the pipelined runner drives the identical
+``step_fn``/``resync`` pair from its comms lane (bit-exact replay:
+``tests/test_overlap.py``).  The buffered asynchronous driver
+(``fl/async_rounds.py``) runs the same recurrence at per-party
+staleness.  ``secure_agg`` and elastic ``join_ticket`` entry are loud
+exclusions (the masked recovery window has not been exercised with a
+post-finalize step; welcomes do not carry server-opt state) — never
+silent fallbacks.
 """
 
 from __future__ import annotations

@@ -165,21 +165,18 @@ def validate_round_config(
                 "fold lives in the streaming/striped aggregators "
                 "(fl.quantize)"
             )
-        if quorum is not None and mode == "ring":
-            raise ValueError(
-                "wire_quant + quorum runs the coordinator topology — "
-                "mode='ring' is a loud exclusion there (the quorum "
-                "ring has not been taught the quantized stripe shape)"
-            )
         incompat_q = {
             "error_feedback": error_feedback,  # quant carries its OWN
             "aggregator": aggregator is not None,
             # PACKED server optimizers (fl.server_opt) compose: the
             # step runs on the exact finalized f32 beside the single
             # rescale.  Only the legacy per-leaf tree optimizers are
-            # excluded here.
+            # excluded here.  overlap=True composes too (the unified
+            # staleness recurrence, fl.overlap): the DGA-corrected
+            # contribution's delta against the round's shared broadcast
+            # reference is exactly the party's local displacement, so
+            # delta-grid coding commutes with the correction.
             "server_opt": legacy_opt is not None,
-            "overlap": overlap,
         }
         bad_q = [k for k, v in incompat_q.items() if v]
         if bad_q:
@@ -411,8 +408,32 @@ def validate_round_config(
                 "the packed wire buffer, and the DGA correction runs on "
                 "it)"
             )
+        if mode == "hierarchy":
+            raise ValueError(
+                "overlap=True is incompatible with mode='hierarchy' — "
+                "the pipelined engine drives the coordinator/ring "
+                "collectives from its comms lane; the hierarchy's "
+                "region-cutoff/regroup protocol has no lane-callable "
+                "collective yet (loud exclusion, never a silent flat "
+                "fallback)"
+            )
+        if secure_agg:
+            raise ValueError(
+                "overlap=True is incompatible with secure_agg — "
+                "pairwise masks are keyed by a synchronous (session, "
+                "stream, round) tuple over the round's full roster; "
+                "the pipelined lane's in-flight round would need a "
+                "mask-recovery window that has never been exercised "
+                "under overlap (loud exclusion)"
+            )
         incompat = {
-            "server_opt": server_opt is not None,
+            # PACKED server optimizers compose via the unified
+            # staleness recurrence (fl.overlap): the correction anchors
+            # on the post-step broadcast, so the step consumes the mean
+            # one-round-stale local displacement as its pseudo-gradient.
+            # Only the legacy per-leaf tree optimizers still need the
+            # materialized synchronous boundary.
+            "server_opt": legacy_opt is not None,
             "aggregator": aggregator is not None,
             "sample": sample is not None and sample != len(trainers),
             "error_feedback": error_feedback,
@@ -462,9 +483,7 @@ def validate_round_config(
             raise ValueError(
                 f"packed server_opt is incompatible with {bad_s} — "
                 f"loud exclusion (see fl.server_opt's composition "
-                f"notes); overlap=True is excluded separately because "
-                f"the DGA correction assumes the broadcast IS the "
-                f"aggregate"
+                f"notes)"
             )
     return {
         "wire_quant": _qname if wire_quant is not None else None,
@@ -526,14 +545,17 @@ def run_fedavg_rounds(
       ``wire_quant``, ``streaming_agg``, ``quorum`` (the cutoff's
       subset refold reweights the step's effective Σw; the replicated
       state survives coordinator failover), ``mode="ring"`` (every
-      controller steps the byte-identical assembly locally) and
+      controller steps the byte-identical assembly locally),
       ``mode="hierarchy"`` (the root steps once; the tree broadcast
-      carries the post-step model); requires ``compress_wire`` +
-      ``packed_wire``; composes with ``join_ticket`` (welcomes carry
-      the spec + a content handle to the replicated state, resolved
-      through the object plane); loudly excluded with ``overlap``/
-      ``secure_agg``/``error_feedback``/``aggregator``/``sample`` —
-      see :mod:`rayfed_tpu.fl.server_opt` and
+      carries the post-step model) and ``overlap=True`` (the unified
+      staleness recurrence: the DGA correction anchors on the
+      post-step broadcast, so the step consumes the mean
+      one-round-stale local displacement — see fl.overlap); requires
+      ``compress_wire`` + ``packed_wire``; composes with
+      ``join_ticket`` (welcomes carry the spec + a content handle to
+      the replicated state, resolved through the object plane); loudly
+      excluded with ``secure_agg``/``error_feedback``/``aggregator``/
+      ``sample`` — see :mod:`rayfed_tpu.fl.server_opt` and
       ``docs/source/server_optimization.rst``.  A legacy
       :mod:`rayfed_tpu.fl.fedopt` ``ServerOptimizer`` keeps the
       per-leaf tree path (coordinator/ring topologies, no
@@ -692,11 +714,14 @@ def run_fedavg_rounds(
       to ``max(compute, comms)`` at the cost of one round of bounded
       staleness (``overlap=False`` keeps today's exact synchronous
       semantics).  Requires ``compress_wire`` + ``packed_wire``;
-      composes with ``mode="coordinator"`` (streaming aggregation) and
+      composes with ``mode="coordinator"`` (streaming aggregation),
       ``mode="ring"`` (with the same-round coordinator fallback on ring
-      aborts); mutually exclusive with ``server_opt``, ``aggregator``,
-      ``sample``, ``error_feedback`` and checkpointing (each needs the
-      exact synchronous round boundary).
+      aborts), ``wire_quant`` and packed ``server_opt`` (the unified
+      staleness recurrence — see :mod:`rayfed_tpu.fl.overlap`);
+      mutually exclusive with legacy ``server_opt``, ``aggregator``,
+      ``sample``, ``error_feedback``, checkpointing, ``secure_agg``,
+      ``quorum`` and ``mode="hierarchy"`` (each needs the exact
+      synchronous round boundary or a lane-callable collective).
     - ``timings``: optional list receiving one ``{"local_s", "push_s",
       "agg_s", "hidden_s"}`` dict per round (seconds; also logged at
       debug level).  ``hidden_s`` is the share of the round's comms wall
@@ -899,6 +924,9 @@ def run_fedavg_rounds(
     if overlap:
         # The pipelined engine owns its own loop shape (double-buffered
         # rounds + DGA correction + comms lane) — see fl/overlap.py.
+        # wire_quant and the packed server optimizer ride along: the
+        # unified staleness recurrence makes the DGA correction commute
+        # with delta-grid coding and with the accelerated server step.
         from rayfed_tpu.fl.overlap import PipelinedRoundRunner
 
         runner = PipelinedRoundRunner(
@@ -909,6 +937,8 @@ def run_fedavg_rounds(
             wire_dtype=wire_dt,
             on_round=on_round,
             ring_chunk_elems=ring_chunk_elems,
+            wire_quant=_qname,
+            server_opt=sopt,
         )
         return runner.run(params, rounds, timings=timings)
 

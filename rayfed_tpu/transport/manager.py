@@ -919,6 +919,7 @@ class TransportManager:
         epoch_tag: Optional[int] = None,
         quant_meta: Optional[Dict[str, Any]] = None,
         blob_offer: bool = False,
+        version_tag: Optional[int] = None,
     ) -> LocalRef:
         """Owner-initiated push.  Returns a LocalRef resolving to True/False.
 
@@ -951,11 +952,18 @@ class TransportManager:
         ``blob_offer``: let the object plane replace a large immutable
         payload with its fingerprint handle (pull-on-demand; see
         :meth:`send_many`).
+
+        ``version_tag``: buffered-async MODEL VERSION stamped into the
+        frame metadata (``wire.ASYNC_VERSION_KEY``) — broadcasts carry
+        the version they publish, contributions the version they
+        trained from, and the coordinator derives staleness from the
+        pair (see :mod:`rayfed_tpu.fl.async_rounds`).
         """
         return self.send_many(
             [dest_party], data, upstream_seq_id, downstream_seq_id,
             stream=stream, round_tag=round_tag, epoch_tag=epoch_tag,
             quant_meta=quant_meta, blob_offer=blob_offer,
+            version_tag=version_tag,
         )[dest_party]
 
     def send_many(
@@ -969,6 +977,7 @@ class TransportManager:
         epoch_tag: Optional[int] = None,
         quant_meta: Optional[Dict[str, Any]] = None,
         blob_offer: bool = False,
+        version_tag: Optional[int] = None,
     ) -> Dict[str, LocalRef]:
         """Fan one value out to N parties — encode once, send concurrently.
 
@@ -1001,6 +1010,8 @@ class TransportManager:
             send_meta[wire.ROUND_TAG_KEY] = str(round_tag)
         if epoch_tag is not None:
             send_meta[wire.EPOCH_TAG_KEY] = str(epoch_tag)
+        if version_tag is not None:
+            send_meta[wire.ASYNC_VERSION_KEY] = str(version_tag)
         if quant_meta is not None:
             import json as _json
 
@@ -1223,6 +1234,12 @@ class TransportManager:
                 meta = message.metadata or {}
                 rnd = meta.get(wire.ROUND_TAG_KEY)
                 ep = meta.get(wire.EPOCH_TAG_KEY)
+                # Buffered-async frames carry a model version instead
+                # of a round tag — surface it as the round so the
+                # flight recorder's per-round pages become per-version
+                # pages with no schema change.
+                if rnd is None:
+                    rnd = meta.get(wire.ASYNC_VERSION_KEY)
                 kw = dict(
                     party=self._party, peer=message.src_party,
                     stream=str(upstream_seq_id),

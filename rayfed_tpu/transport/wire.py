@@ -150,6 +150,18 @@ EPOCH_TAG_KEY = "ep"
 # tool/check_wire_format.py.
 QUANT_GRID_KEY = "qg"
 
+# Metadata key carrying the coordinator's MODEL VERSION for buffered
+# asynchronous rounds (fl.async_rounds): async broadcasts are stamped
+# with the version they publish, and async contributions with the
+# version of the broadcast they trained FROM — the coordinator derives
+# each arrival's staleness as (current_version - trained_from) and a
+# version-stale contribution against a rotated grid re-codes through
+# the shared RoundCodec instead of folding garbage.  Same meta-dict
+# transport as ROUND_TAG_KEY (the synchronous loops' round index plays
+# this role there): no frame-layout change, but the key name is a
+# cross-party contract — fingerprinted by tool/check_wire_format.py.
+ASYNC_VERSION_KEY = "av"
+
 # Content-addressed object plane (transport/objectstore.py): the
 # repo's FIRST pull direction.  Three frame-metadata keys, all riding
 # the ordinary per-send "meta" dict — NO frame-layout change, but the

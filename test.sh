@@ -114,11 +114,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # one broadcast in), and (3) hier_ingress_flatness <= 1.6 — the
 # max-ingress-at-any-node ratio between N=64 and N=4 stays ~flat (no
 # O(N) hub at ANY level; the flat hub's coordinator ingress scales
-# ~N/2x over the same range), and (4) hier_round_ratio_64_over_16 <= 8
-# — the N=64 round wall within 8x of N=16 although the message count
-# grows ~14x (the local-link fast path's per-message-cost gate; ~23x
-# before it), with flight-recorder trace_phases attribution landing in
-# the report alongside the number.  MULTI-LEVEL gates (N=256, 16
+# ~N/2x over the same range), and (4) hier_round_ratio_64_over_16 <= 12
+# — the N=64 round wall stays well sublinear in the ~14x message-count
+# growth over N=16 (the local-link fast path's per-message-cost gate;
+# ~23x before it), with flight-recorder trace_phases attribution
+# landing in the report alongside the number.  The denominator is the
+# slower of two N=16 walls bracketing the N=64 leg so host-speed drift
+# between measurement windows cannot read as a per-message regression;
+# the threshold is 12, not 8, because identical code (clean HEAD
+# included) measured 6.8-10.2 across back-to-back runs on a 1-vCPU CI
+# host — the ~200ms N=16 leg's min-of-3 swings 40% on scheduler luck.  MULTI-LEVEL gates (N=256, 16
 # regions x 16 folding through branch=4 interior nodes, quorum-hub
 # leaves + region-ring downlink; FD-ceiling-checked, skipped only
 # when the soft limit cannot reach 4096): (5)
@@ -150,6 +155,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 # tool/trace_report per-round critical-path walls that reconcile with
 # the driver's own measured walls within 25%, exports non-empty
 # Perfetto trace_event JSON, and carries spans from all 4 parties.
+# BUFFERED-ASYNC gates (fl/async_rounds.py, ROADMAP item 2):
+# async_tt_frac <= 0.8 — time-to-target-loss of the buffered-async
+# fleet at most 0.8x the synchronous barrier's on the SAME quadratic
+# workload under the SAME seeded 2-10x local_slowdown straggler
+# schedule (the barrier pays the straggler's stretched step every
+# round; the buffer folds it in stale and shift-decayed instead);
+# async_refold_bitexact — every emitted model version BYTE-identical
+# to a sorted packed_quantized_sum refold of its recorded fold set
+# (the order-free exact-integer-decay contract, certified on the CI
+# host, not just in the unit suite); async_versions_per_sec >= 1.0 —
+# the N=64 in-process virtual-party fleet keeps emitting versions
+# (the coordinator's running donated-i32 fold + re-park loop must
+# not degrade to per-push model rebuilds; measured ~5/s).
 JAX_PLATFORMS=cpu python bench.py --smoke
 
 echo "All tests finished."

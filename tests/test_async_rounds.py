@@ -1,0 +1,332 @@
+"""Buffered asynchronous rounds (fl.async_rounds): exact integer
+staleness decay, adversarial arrival-order byte-identity, grid
+rotation + RoundCodec re-coding, and the in-process virtual-party
+fleet (loopback managers, no party subprocesses — the tier-1 budget
+rides in-process fleets)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from rayfed_tpu import chaos, telemetry
+from rayfed_tpu.fl import async_rounds as ar
+from rayfed_tpu.fl import quantize as qz
+from rayfed_tpu.fl.compression import PackedTree, pack_tree
+from rayfed_tpu.fl.fedavg import packed_quantized_sum
+from rayfed_tpu.fl.server_opt import fedac
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    ar.reset_async_stats()
+    qz.reset_compressors()
+    yield
+    chaos.uninstall()
+    telemetry.uninstall()
+    ar.reset_async_stats()
+    qz.reset_compressors()
+
+
+def _template(d=500, seed=7):
+    rng = np.random.default_rng(seed)
+    params = {
+        "x": jnp.asarray(np.linspace(-1.0, 1.0, d, dtype=np.float32)),
+        "y": jnp.asarray(rng.standard_normal(7).astype(np.float32)),
+    }
+    tmpl = pack_tree(params, jnp.float32)
+    return params, tmpl, np.asarray(tmpl.buf).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# The exact integer decay
+# ---------------------------------------------------------------------------
+
+
+def test_decay_weight_is_exact_integer_shift():
+    assert ar.decay_weight(64, 0) == 64
+    assert ar.decay_weight(64, 3) == 8
+    assert ar.decay_weight(1, 1) == 0  # unit weight decays out at s=1
+    # Beyond the cap every staleness decays identically.
+    assert ar.decay_weight(1 << 20, 8) == ar.decay_weight(1 << 20, 99)
+    assert ar.decay_weight(1 << 20, 3, staleness_cap=2) == (1 << 20) >> 2
+    with pytest.raises(ValueError, match="integral weights"):
+        ar.decay_weight(1.5, 0)
+    with pytest.raises(ValueError, match="integral weights"):
+        ar.decay_weight(-2, 0)
+    with pytest.raises(ValueError, match="never negative"):
+        ar.decay_weight(4, -1)
+
+
+def test_bootstrap_grid_is_negotiation_free():
+    """Every controller derives the SAME version-0 abs grid from the
+    bit-identical initial params — the fingerprint IS the handshake."""
+    _, _, buf = _template()
+    g1 = ar.bootstrap_grid(buf.copy(), "uint8", 64)
+    g2 = ar.bootstrap_grid(buf.copy(), "uint8", 64)
+    assert g1.mode == "abs"
+    assert g1.fingerprint() == g2.fingerprint()
+    assert g1.fingerprint() != ar.bootstrap_grid(
+        buf + np.float32(0.5), "uint8", 64
+    ).fingerprint()
+    # An all-constant init would clip every v0 contribution to itself
+    # and pin the zero-delta grid forever — refused at derivation.
+    with pytest.raises(ValueError, match="all-constant"):
+        ar.bootstrap_grid(np.zeros(256, np.float32), "uint8", 64)
+
+
+# ---------------------------------------------------------------------------
+# The running buffer: order-free by integer arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _coded_set(tmpl, ref, n=9, seed=0, ce=64):
+    rng = np.random.default_rng(seed)
+    grid = qz.make_round_grid(
+        (1e-2 * rng.standard_normal(ref.size)).astype(np.float32),
+        chunk_elems=ce, wire_dtype="uint8", mode="delta",
+    )
+    qts, ws, ss = [], [], []
+    for _ in range(n):
+        contrib = PackedTree(
+            ref + (1e-2 * rng.standard_normal(ref.size)).astype(
+                np.float32
+            ),
+            tmpl.passthrough, tmpl.spec,
+        )
+        qts.append(qz.quantize_packed(contrib, grid, ref=ref))
+        ws.append(int(rng.integers(1, 64)))
+        ss.append(int(rng.integers(0, 5)))
+    return grid, qts, ws, ss
+
+
+def test_async_buffer_adversarial_order_refold_identity():
+    """The tentpole contract: ANY arrival order folds to bytes
+    identical to the sorted-order ``packed_quantized_sum`` refold of
+    the same contribution set at the shift-decayed weights — including
+    sets where the decay drops some contributions entirely."""
+    _, tmpl, ref = _template()
+    grid, qts, ws, ss = _coded_set(tmpl, ref)
+    ws[0], ss[0] = 1, 3  # decays to zero: the dropped population
+    w_effs = [ar.decay_weight(w, s) for w, s in zip(ws, ss)]
+    keep = [i for i, w in enumerate(w_effs) if w > 0]
+    assert 0 < len(keep) < len(qts)  # both populations exercised
+    oracle = np.asarray(
+        packed_quantized_sum(
+            [qts[i] for i in keep], [w_effs[i] for i in keep], ref=ref
+        ).buf
+    )
+    orders = [
+        list(range(len(qts))),
+        list(reversed(range(len(qts)))),
+    ] + [
+        list(np.random.default_rng(k).permutation(len(qts)))
+        for k in range(3)
+    ]
+    for order in orders:
+        buf = ar.AsyncBuffer(grid, ref, tmpl)
+        for i in order:
+            got = buf.fold(qts[i], ws[i], ss[i])
+            assert got == w_effs[i]
+        assert buf.occupancy == len(keep)  # dropped folds never occupy
+        assert buf.total_weight == sum(w_effs)
+        out = buf.finalize(np.float32)
+        assert out.spec.wire_dtype == "float32"
+        assert np.array_equal(np.asarray(out.buf), oracle)
+
+
+def test_async_buffer_reset_rotates_grid_in_place():
+    """reset() starts the next version on a rotated grid without
+    rebuilding the accumulator layout, and the second version's fold
+    is as exact as the first."""
+    _, tmpl, ref = _template()
+    grid, qts, ws, ss = _coded_set(tmpl, ref)
+    buf = ar.AsyncBuffer(grid, ref, tmpl)
+    for qt, w, s in zip(qts, ws, ss):
+        buf.fold(qt, w, s)
+    first = np.asarray(buf.finalize(np.float32).buf)
+    grid2, qts2, ws2, _ = _coded_set(tmpl, first, seed=1)
+    buf.reset(grid2, first)
+    assert buf.occupancy == 0
+    for qt, w in zip(qts2, ws2):
+        buf.fold(qt, w, 0)
+    oracle2 = np.asarray(
+        packed_quantized_sum(qts2, ws2, ref=first).buf
+    )
+    assert np.array_equal(
+        np.asarray(buf.finalize(np.float32).buf), oracle2
+    )
+
+
+def test_async_buffer_guards():
+    _, tmpl, ref = _template()
+    grid, qts, ws, _ = _coded_set(tmpl, ref)
+    buf = ar.AsyncBuffer(grid, ref, tmpl)
+    # Codes from a different grid must re-code first, never fold.
+    other = qz.make_round_grid(
+        np.full(ref.size, 0.5, np.float32), chunk_elems=64,
+        wire_dtype="uint8", mode="delta",
+    )
+    alien = qz.quantize_packed(
+        PackedTree(ref.copy(), tmpl.passthrough, tmpl.spec),
+        other, ref=ref,
+    )
+    with pytest.raises(ValueError, match="re-code through the shared"):
+        buf.fold(alien, 1, 0)
+    # The i32 headroom guard fires BEFORE the accumulator is touched.
+    with pytest.raises(ValueError, match="integer-fold overflow"):
+        buf.fold(qts[0], (2**31 - 1) // grid.qabs_max + 1, 0)
+    assert buf.occupancy == 0
+    with pytest.raises(ValueError, match="empty buffer"):
+        buf.finalize()
+    with pytest.raises(ValueError, match="shared reference buffer"):
+        buf.reset(grid, None)  # delta grid needs its reference
+
+
+# ---------------------------------------------------------------------------
+# The fleet: in-process virtual parties over loopback managers
+# ---------------------------------------------------------------------------
+
+
+def _local_step(party, packed, version, cycle):
+    seed = (abs(hash(party)) & 0xFFFF) * 1000 + version * 37 + cycle
+    rng = np.random.default_rng(seed)
+    buf = np.asarray(packed.buf).astype(np.float32)
+    new = buf - np.float32(0.05) * (buf - np.float32(0.25)) + (
+        1e-3 * rng.standard_normal(buf.size)
+    ).astype(np.float32)
+    return PackedTree(new, packed.passthrough, packed.spec)
+
+
+def _check_version_refold(version_log, record_folds):
+    """Per emitted version: refold the version's recorded (codes,
+    w_eff) set sorted through packed_quantized_sum — the emitted model
+    must be byte-identical (server_opt None)."""
+    by_v = collections.defaultdict(list)
+    for f in record_folds:
+        if f["w_eff"] > 0:
+            by_v[f["version"]].append(f)
+    checked = 0
+    prev_model = None
+    for rec in version_log:
+        fold_set = sorted(
+            by_v[rec["version"] - 1], key=lambda f: f["party"]
+        )
+        assert fold_set, "an emitted version folded nothing"
+        qts = [f["qt"] for f in fold_set]
+        g = qts[0].grid()
+        ref = prev_model if g.mode == "delta" else None
+        oracle = packed_quantized_sum(
+            qts, [f["w_eff"] for f in fold_set], ref=ref
+        )
+        assert np.array_equal(np.asarray(oracle.buf), rec["model"])
+        checked += 1
+        prev_model = rec["model"]
+    return checked
+
+
+def test_async_fleet_version_refold_identity():
+    """End-to-end over real loopback transport: adversarial arrival
+    orders decided by thread scheduling, heterogeneous weights and
+    cycle counts (roster churn), grid rotation every version, and
+    version-stale contributions re-coding through the RoundCodec —
+    every emitted version byte-identical to its sorted refold."""
+    params, _, _ = _template(d=300)
+    vlog, folds = [], []
+    out = ar.run_async_fleet(
+        ["coord", "a", "b", "c"], params, _local_step,
+        cycles={"a": 5, "b": 5, "c": 3},
+        weights={"a": 8, "b": 16, "c": 32},
+        buffer_k=3, chunk_elems=64, timeout_s=120,
+        version_log=vlog, record_folds=folds,
+    )
+    assert out["versions"] == len(vlog) >= 3
+    assert out["folds"] == sum(r["folds"] for r in vlog) == 13
+    checked = _check_version_refold(vlog, folds)
+    assert checked == out["versions"]
+    assert np.array_equal(vlog[-1]["model"], out["w"])
+    # Roster churn: every member's final push bumped the epoch.
+    assert out["epoch"] == 3
+    # Concurrency was real: some arrivals were version-stale and
+    # re-coded onto the rotated grid.
+    assert ar.ASYNC_STATS["recoded_stale"] > 0
+    assert ar.ASYNC_STATS["versions_emitted"] == out["versions"]
+    assert sum(ar.ASYNC_STATS["staleness_hist"].values()) == 13
+    for r in out["party_results"].values():
+        assert 0 < r["version"] <= out["versions"]
+
+
+def test_async_fleet_chaos_straggler_spread():
+    """A seeded ``local_slowdown`` schedule turns the homogeneous
+    in-process fleet into a deterministic straggler spread; the
+    buffered rounds absorb it — nothing is cut, every contribution
+    folds, and the straggler's contributions arrive STALE (nonzero
+    decay shifts) instead of stalling a barrier."""
+    params, _, _ = _template(d=200)
+    chaos.install({
+        "seed": 5,
+        "rules": [{
+            "hook": "local_step", "party": "b",
+            "op": "local_slowdown", "value": [4.0, 10.0],
+        }],
+    })
+    rec = telemetry.install("async_chaos_test")
+    vlog, folds = [], []
+    out = ar.run_async_fleet(
+        ["coord", "a", "b"], params, _local_step,
+        cycles=4, weights={"a": 16, "b": 16},
+        buffer_k=2, chunk_elems=64, timeout_s=120,
+        version_log=vlog, record_folds=folds,
+    )
+    assert out["folds"] == 8  # nobody was cut
+    assert _check_version_refold(vlog, folds) == out["versions"]
+    sched = chaos.installed()
+    assert sched is not None and sched.rules[0].fired == 4
+    # The flight recorder's staleness attribution: every fold span is
+    # version-tagged (the round tag) and carries the decay detail the
+    # trace_report staleness section aggregates.
+    fold_spans = [r for r in rec.records() if r.phase == "async.fold"]
+    assert len(fold_spans) == 8
+    for r in fold_spans:
+        assert r.round is not None
+        assert "staleness" in r.detail and "w_eff" in r.detail
+    assert [r for r in rec.records() if r.phase == "async.version"]
+    assert [r for r in rec.records() if r.phase == "async.local"]
+    # tool/trace_report.py turns those details into the per-version
+    # staleness attribution (versions ride the round tag).
+    from tool.trace_report import format_report, round_report
+
+    recs = [r._asdict() for r in rec.records()]
+    rep = round_report(recs)
+    st_sections = [
+        info["staleness"] for info in rep.values() if info["staleness"]
+    ]
+    assert st_sections
+    assert sum(s["folds"] for s in st_sections) == 8
+    assert sum(s["weight_pushed"] for s in st_sections) == 8 * 16
+    text = format_report(recs)
+    assert "staleness:" in text
+
+
+def test_async_fleet_server_opt_composes():
+    """The accelerated server step consumes the buffered mean at
+    per-party staleness (the async end of the unified staleness
+    recurrence) — same step/resync pair as the synchronous loop."""
+    params, _, _ = _template(d=200)
+    plain = ar.run_async_fleet(
+        ["coord", "a", "b"], params, _local_step,
+        cycles=3, weights={"a": 8, "b": 8}, buffer_k=2,
+        chunk_elems=64, timeout_s=120,
+    )
+    qz.reset_compressors()
+    ar.reset_async_stats()
+    accel = ar.run_async_fleet(
+        ["coord", "a", "b"], params, _local_step,
+        cycles=3, weights={"a": 8, "b": 8}, buffer_k=2,
+        chunk_elems=64, timeout_s=120,
+        server_opt=fedac(1.0, 3.0, 0.5),
+    )
+    assert accel["versions"] > 0
+    assert not np.array_equal(plain["w"], accel["w"])

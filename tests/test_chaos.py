@@ -127,6 +127,56 @@ def test_seeded_delay_is_deterministic():
     assert all(0.010 <= d <= 0.050 for d in da)
 
 
+def test_local_slowdown_validation():
+    for bad in (None, 0.5, [0.5, 2.0], [4.0, 2.0], [2.0]):
+        with pytest.raises(ValueError, match="local_slowdown op needs"):
+            chaos.ChaosSchedule({"rules": [
+                {"hook": "local_step", "op": "local_slowdown",
+                 "value": bad},
+            ]})
+
+
+def test_local_slowdown_stretches_measured_baseline():
+    """The multiplier op sleeps ``baseline_s * (m - 1)`` — it scales
+    with the REAL compute the hook site measured, unlike delay_ms's
+    absolute stall — and is a standing condition (a slow device stays
+    slow: count defaults to unbounded)."""
+    chaos.install({"seed": 9, "rules": [
+        {"hook": "local_step", "party": "b", "op": "local_slowdown",
+         "value": 3.0},
+    ]})
+    for _ in range(3):  # persists across fires
+        t0 = time.perf_counter()
+        chaos.fire("local_step", party="b", version=0, cycle=0,
+                   baseline_s=0.02)
+        assert time.perf_counter() - t0 >= 0.02 * (3.0 - 1.0) * 0.9
+    # Other parties' steps are untouched.
+    t0 = time.perf_counter()
+    chaos.fire("local_step", party="a", version=0, cycle=0,
+               baseline_s=0.02)
+    assert time.perf_counter() - t0 < 0.02
+    # No reported baseline -> no stall (absolute stalls are delay_ms).
+    t0 = time.perf_counter()
+    chaos.fire("local_step", party="b", version=1, cycle=1)
+    assert time.perf_counter() - t0 < 0.02
+
+
+def test_local_slowdown_range_draw_is_seeded():
+    """A [lo, hi] multiplier draws from the rule's seeded rng — the
+    2-10x straggler spread replays identically run to run."""
+    spec = {"seed": 7, "rules": [
+        {"hook": "local_step", "op": "local_slowdown",
+         "value": [2.0, 10.0]},
+    ]}
+    a = chaos.ChaosSchedule(spec)
+    b = chaos.ChaosSchedule(spec)
+    da = [a.rules[0].slowdown() for _ in range(6)]
+    db = [b.rules[0].slowdown() for _ in range(6)]
+    assert da == db
+    assert all(2.0 <= m <= 10.0 for m in da)
+    assert len(set(da)) > 1  # a spread, not a constant
+
+
 def test_env_install(monkeypatch):
     monkeypatch.setenv(
         chaos.ENV_VAR,

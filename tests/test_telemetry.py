@@ -400,6 +400,13 @@ def _snapshot_party_run(party, cluster):
                 section, key, type(snap[section][key]),
             )
     assert snap["telemetry"]["trace_armed"] is False  # disarmed run
+    # The async section snapshots fl.async_rounds.ASYNC_STATS; the
+    # histogram must be a copy, never an alias of the live counter.
+    from rayfed_tpu.fl.async_rounds import ASYNC_STATS
+
+    assert snap["async"]["versions_emitted"] == 0  # no async run here
+    snap["async"]["staleness_hist"]["poison"] = 1
+    assert "poison" not in ASYNC_STATS["staleness_hist"]
     fed.shutdown()
 
 

@@ -275,6 +275,11 @@ def compute_fingerprint() -> str:
             # rejected loudly).  Same meta-dict transport as the round
             # tag — no frame-layout change, but a cross-party contract.
             "epoch_tag_key": wire.EPOCH_TAG_KEY,
+            # Buffered-async rounds: the metadata key carrying the
+            # model VERSION a frame belongs to (fl.async_rounds — the
+            # async analogue of the round tag).  Same meta-dict
+            # transport — no frame-layout change, key name is contract.
+            "async_version_key": wire.ASYNC_VERSION_KEY,
             "ring_stripe_schema": _schema(stripe_manifest),
             "ring_stripe_quant_schema": _schema(stripe_manifest_quant),
             "ring_stripe_version": ring.RING_STRIPE_VERSION,

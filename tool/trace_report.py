@@ -22,6 +22,11 @@ raw N-party logs cannot: **which party/phase bounded the round wall**.
   with the round — plus untagged ones whose timestamp falls inside the
   round window (an injected partition appears next to the failover it
   caused).
+- *staleness*: buffered-async rounds (fl.async_rounds) tag each model
+  version as a round and stamp the decay attribution into their
+  ``async.fold`` span details; the report aggregates them per version —
+  staleness histogram, pushed-vs-folded weight, and the share each
+  peer's contributions lost to the integer shift decay.
 
 The driver's own measured wall (``driver.round`` duration) reconciles
 with the report's window within tolerance — ``bench.py --smoke``'s
@@ -93,6 +98,54 @@ def hier_level_attribution(
     return dict(
         sorted(levels.items(), key=lambda kv: kv[1], reverse=True)
     )
+
+
+def staleness_attribution(
+    recs: Sequence[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Aggregate the buffered-async fold spans (``async.fold``) in a
+    record window: how stale the folded contributions were and how much
+    pushed weight the integer shift decay cost, overall and per peer.
+    Empty dict when the window holds no async folds (synchronous
+    rounds)."""
+    hist: Dict[int, int] = {}
+    w_in = 0
+    w_folded = 0
+    peers: Dict[str, Dict[str, Any]] = {}
+    folds = 0
+    for r in recs:
+        if str(r.get("phase")) != "async.fold":
+            continue
+        d = r.get("detail") or {}
+        if "staleness" not in d:
+            continue
+        folds += 1
+        s = int(d.get("staleness") or 0)
+        w = int(d.get("weight") or 0)
+        we = int(d.get("w_eff") or 0)
+        hist[s] = hist.get(s, 0) + 1
+        w_in += w
+        w_folded += we
+        p = peers.setdefault(
+            str(r.get("peer")),
+            {"folds": 0, "staleness_sum": 0, "weight": 0, "w_eff": 0},
+        )
+        p["folds"] += 1
+        p["staleness_sum"] += s
+        p["weight"] += w
+        p["w_eff"] += we
+    if not folds:
+        return {}
+    return {
+        "folds": folds,
+        "staleness_hist": dict(sorted(hist.items())),
+        "weight_pushed": w_in,
+        "weight_folded": w_folded,
+        "decayed_frac": (
+            (w_in - w_folded) / w_in if w_in else 0.0
+        ),
+        "peers": peers,
+    }
 
 
 def load_records(doc: Any) -> List[Dict[str, Any]]:
@@ -223,9 +276,10 @@ def round_report(
     driver span was collected), ``wall_agrees`` (the two reconcile
     within ``tolerance``, relative), ``chain`` (critical-path
     segments), ``hier_levels`` (critical-path seconds per hierarchy
-    tree level, empty for non-hierarchy rounds), ``bounded_by`` (the
-    chain's largest segment), ``straggler`` (largest ``local_s``), and
-    ``events``."""
+    tree level, empty for non-hierarchy rounds), ``staleness``
+    (:func:`staleness_attribution` over the window — buffered-async
+    versions only), ``bounded_by`` (the chain's largest segment),
+    ``straggler`` (largest ``local_s``), and ``events``."""
     out: Dict[int, Dict[str, Any]] = {}
     records = list(records)
     for rnd in rounds_of(records):
@@ -264,6 +318,7 @@ def round_report(
             "wall_agrees": agrees,
             "chain": chain,
             "hier_levels": hier_level_attribution(chain),
+            "staleness": staleness_attribution(recs),
             "bounded_by": bounded,
             "straggler": straggler,
             "straggler_local_s": local_best,
@@ -317,6 +372,27 @@ def format_report(
                     for lbl, dur in info["hier_levels"].items()
                 )
             )
+        if info.get("staleness"):
+            st = info["staleness"]
+            lines.append(
+                f"  staleness: {st['folds']} folds, hist "
+                + " ".join(
+                    f"s{s}x{n}"
+                    for s, n in st["staleness_hist"].items()
+                )
+                + f", decayed {100.0 * st['decayed_frac']:.0f}% of "
+                f"pushed weight"
+            )
+            worst = max(
+                st["peers"].items(),
+                key=lambda kv: kv[1]["staleness_sum"],
+            )
+            if worst[1]["staleness_sum"]:
+                lines.append(
+                    f"    stalest peer {worst[0]}: "
+                    f"{worst[1]['folds']} folds, mean staleness "
+                    f"{worst[1]['staleness_sum'] / worst[1]['folds']:.1f}"
+                )
         for seg in info["chain"]:
             lines.append(
                 f"    {seg['dur_s'] * 1e3:9.2f} ms  "
